@@ -1,0 +1,7 @@
+"""YAML config system preserving the reference's config schema."""
+
+from neuronx_distributed_training_tpu.config.loader import (  # noqa: F401
+    ConfigDict,
+    load_config,
+    validate_config,
+)

@@ -1,0 +1,1 @@
+"""Core sharded ops: parallel linears/embedding, norms, RoPE, attention, losses."""

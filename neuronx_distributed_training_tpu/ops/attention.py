@@ -1,0 +1,123 @@
+"""Attention ops.
+
+``core_attention`` is the numerics-reference implementation, the counterpart of
+the reference's ``CoreAttention`` (naive attention, causal mask, fp32 softmax —
+``modeling_llama.py:226-251``).  ``attention`` dispatches between it and the
+Pallas flash/ring kernels the same way the reference dispatches
+``nki_flash_attn_func`` / ``nki_ring_attn_func`` / ``CoreAttention``
+(``modeling_llama.py:482-489``), controlled by the ``fusions`` config block.
+
+Layout is ``[batch, seq, heads, head_dim]`` throughout (the TPU-friendly layout;
+the reference's ``transpose_nki_inputs`` permutation concern disappears because
+Pallas block specs handle layout inside the kernel).
+
+GQA: K/V carry ``kv_heads`` heads and are repeated to ``heads`` on the fly; the
+reference's ``kv_shared_group_size`` KV replication trick
+(``modeling_llama.py:310-320``) is unnecessary under GSPMD — when
+``tp > kv_heads`` XLA replicates the KV shards automatically from the specs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[b, s, kv_heads, d] -> [b, s, kv_heads * n_rep, d]."""
+    if n_rep == 1:
+        return x
+    b, s, kvh, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, kvh, n_rep, d))
+    return x.reshape(b, s, kvh * n_rep, d)
+
+
+def causal_mask_bias(
+    q_len: int,
+    kv_len: int,
+    *,
+    q_offset: int = 0,
+    sliding_window: Optional[int] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Additive attention bias ``[q_len, kv_len]``: 0 where visible, large
+    negative where masked.  ``q_offset`` is the absolute position of query row 0
+    (used by context parallelism).  ``sliding_window`` adds the Mixtral-style
+    window mask (reference ``modeling_mixtral.py:145-148``)."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = jnp.arange(kv_len)[None, :]
+    visible = kv_pos <= q_pos
+    if sliding_window is not None:
+        visible = visible & (kv_pos > q_pos - sliding_window)
+    # -10000-style finite fill like the reference (modeling_llama.py:226-251)
+    # is unnecessary; use a dtype-safe large negative.
+    neg = jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+    return jnp.where(visible, jnp.asarray(0, dtype), neg)
+
+
+def core_attention(
+    q: jax.Array,  # [b, sq, h, d]
+    k: jax.Array,  # [b, skv, kvh, d]
+    v: jax.Array,  # [b, skv, kvh, d]
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    sliding_window: Optional[int] = None,
+    bias: Optional[jax.Array] = None,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Naive attention with fp32 (configurable) softmax; the numerics gate for
+    the Pallas kernels."""
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        k = repeat_kv(k, h // kvh)
+        v = repeat_kv(v, h // kvh)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, softmax_dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=softmax_dtype)
+    scores = scores.astype(softmax_dtype) * scale
+    if causal:
+        scores = scores + causal_mask_bias(
+            sq, k.shape[1], q_offset=q_offset, sliding_window=sliding_window, dtype=softmax_dtype
+        )
+    if bias is not None:
+        scores = scores + bias.astype(softmax_dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "core",  # "core" | "flash" | "ring"
+    causal: bool = True,
+    q_offset: int = 0,
+    sliding_window: Optional[int] = None,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Dispatch mirroring the reference's flash/ring/Core selection
+    (``modeling_llama.py:482-489``)."""
+    if impl == "flash":
+        from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, sliding_window=sliding_window
+        )
+    if impl == "ring":
+        from neuronx_distributed_training_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
+    return core_attention(
+        q,
+        k,
+        v,
+        causal=causal,
+        q_offset=q_offset,
+        sliding_window=sliding_window,
+        softmax_dtype=softmax_dtype,
+    )

@@ -1,0 +1,81 @@
+"""Vocab-parallel cross-entropy.
+
+The reference computes the loss on vocab-sharded logits with NxD's
+``parallel_cross_entropy`` (reference ``modeling_llama.py:79,825-833``,
+``gpt_model.py:34-67``) — an explicit max/sum all-reduce over the TP group.
+Under GSPMD the same program falls out of a plain stable cross-entropy written
+with full-axis reductions over the (sharded) vocab dim: XLA partitions the
+reductions and inserts the TP collectives.  The label-logit gather is expressed
+as a masked sum (iota == label) so it partitions cleanly instead of becoming a
+cross-shard gather.
+
+Also provides ``logprobs_from_logits`` — the vocab-parallel log-prob helper DPO
+needs (reference ``from_parallel_logits_to_logprobs``, ``base_dpo.py:34-46``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _label_logit_and_lse(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-token (label_logit, logsumexp) in fp32. logits [..., vocab], labels [...]."""
+    logits = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + jnp.squeeze(m, -1)
+    vocab = logits.shape[-1]
+    onehot_mask = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1) == labels[
+        ..., None
+    ]
+    label_logit = jnp.sum(jnp.where(onehot_mask, logits, 0.0), axis=-1)
+    return label_logit, lse
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [batch, seq, vocab] (vocab may be sharded over "model")
+    labels: jax.Array,  # [batch, seq] int; ignore_index entries masked out
+    *,
+    loss_mask: Optional[jax.Array] = None,  # [batch, seq] {0,1}
+    ignore_index: int = -100,
+    reduction: str = "mean",  # "mean" | "sum" | "none"
+) -> jax.Array:
+    """Stable CE over (possibly sharded) vocab; masked mean over valid tokens."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    label_logit, lse = _label_logit_and_lse(logits, safe_labels)
+    per_tok = lse - label_logit
+    mask = valid.astype(jnp.float32)
+    if loss_mask is not None:
+        mask = mask * loss_mask.astype(jnp.float32)
+    per_tok = per_tok * mask
+    if reduction == "none":
+        return per_tok
+    total = jnp.sum(per_tok)
+    if reduction == "sum":
+        return total
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom
+
+
+def shift_for_next_token(
+    logits: jax.Array, labels: jax.Array, loss_mask: Optional[jax.Array] = None
+):
+    """Standard causal-LM shift: predict token t+1 from position t.
+
+    Context-parallel runs pre-shift labels on the host instead and skip this
+    (reference ``modeling_llama.py:815-823``)."""
+    shifted_logits = logits[:, :-1, :]
+    shifted_labels = labels[:, 1:]
+    shifted_mask = None if loss_mask is None else loss_mask[:, 1:]
+    return shifted_logits, shifted_labels, shifted_mask
+
+
+def logprobs_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token log p(label) from (sharded) logits — the DPO/ORPO helper
+    (reference ``from_parallel_logits_to_logprobs``, ``base_dpo.py:34-46``)."""
+    label_logit, lse = _label_logit_and_lse(logits, labels)
+    return label_logit - lse

@@ -1,0 +1,45 @@
+"""Normalization layers.
+
+RMSNorm with fp32 internals regardless of compute dtype — the reference's
+``LlamaRMSNorm`` upcasts to the cast-dtype before the variance reduction
+(``modeling_llama.py:145-161``); here the upcast is explicit and local.
+The fused-kernel concern of ``fused_layer_norm.py`` (apex MixedFusedLayerNorm /
+MixedFusedRMSNorm, reference ``fused_layer_norm.py:14-36``) is handled by XLA
+fusion on TPU; a Pallas fused variant exists for the flash-attention path where
+profiling warrants it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_rms_norm(hidden: int, *, dtype=jnp.float32):
+    return {"scale": jnp.ones((hidden,), dtype)}, {"scale": P(None)}
+
+
+def apply_rms_norm(params, x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * params["scale"].astype(jnp.float32)).astype(orig_dtype)
+
+
+def init_layer_norm(hidden: int, *, dtype=jnp.float32):
+    return (
+        {"scale": jnp.ones((hidden,), dtype), "bias": jnp.zeros((hidden,), dtype)},
+        {"scale": P(None), "bias": P(None)},
+    )
+
+
+def apply_layer_norm(params, x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    return out.astype(orig_dtype)

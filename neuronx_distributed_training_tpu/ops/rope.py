@@ -1,0 +1,73 @@
+"""Rotary position embeddings.
+
+Covers the reference's two RoPE implementations: the HF-style
+``LlamaRotaryEmbedding`` with fp64-precision inv-freq override
+(``modeling_llama.py:847-873``) and Megatron's ``rotary_pos_embedding.py`` with
+position-interpolation and ABF base scaling (``rotary_pos_embedding.py:22-81``).
+Frequencies are computed in fp64 on host at trace time (static) then applied in
+fp32 — matching the reference's precision discipline without any global flag.
+
+Context parallelism offsets positions per CP shard (reference
+``modeling_llama.py:619-629``); callers pass explicit ``positions`` so the same
+code serves CP, packed sequences, and inference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    *,
+    theta: float = 10000.0,
+    position_interpolation_factor: float | None = None,
+    abf_scale: float | None = None,
+) -> np.ndarray:
+    """Inverse frequencies ``[head_dim/2]`` in fp64 (host-side, static).
+
+    ``abf_scale`` scales the base theta (adjusted-base-frequency, reference
+    ``rotary_pos_embedding.py``); ``position_interpolation_factor`` divides
+    positions at application time.
+    """
+    base = float(theta)
+    if abf_scale is not None:
+        base = base * abf_scale
+    exponent = np.arange(0, head_dim, 2, dtype=np.float64) / head_dim
+    inv_freq = 1.0 / (base**exponent)
+    if position_interpolation_factor:
+        inv_freq = inv_freq / float(position_interpolation_factor)
+    return inv_freq
+
+
+def rope_cos_sin(
+    positions: jax.Array,  # [batch, seq] or [seq]
+    inv_freq: np.ndarray,
+    *,
+    dtype=jnp.float32,
+):
+    """cos/sin tables for given positions: ``[..., seq, head_dim/2]``."""
+    angles = positions.astype(jnp.float32)[..., None] * jnp.asarray(inv_freq, jnp.float32)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate ``x: [batch, seq, heads, head_dim]`` (HF half-rotation layout).
+
+    cos/sin are ``[batch, seq, head_dim/2]`` (or ``[seq, head_dim/2]``).
+    """
+    orig_dtype = x.dtype
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    if cos.ndim == 2:  # [seq, half] -> broadcast over batch
+        cos_b = cos[None, :, None, :]
+        sin_b = sin[None, :, None, :]
+    else:  # [batch, seq, half]
+        cos_b = cos[:, :, None, :]
+        sin_b = sin[:, :, None, :]
+    out1 = x1 * cos_b - x2 * sin_b
+    out2 = x2 * cos_b + x1 * sin_b
+    return jnp.concatenate([out1, out2], axis=-1).astype(orig_dtype)

@@ -1,0 +1,9 @@
+"""Optimizers and LR schedules."""
+
+from neuronx_distributed_training_tpu.optim.adamw import (  # noqa: F401
+    AdamWConfig,
+    adamw_update,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.optim.lr import build_lr_schedule  # noqa: F401

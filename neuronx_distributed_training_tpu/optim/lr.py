@@ -1,0 +1,72 @@
+"""LR schedules.
+
+``LinearAnnealingWithWarmUp`` reproduces the reference's registered scheduler
+(``optim/lr_schedulers.py:11-23``): HF-style linear warmup to ``lr`` over
+``warmup_steps`` then linear decay to ``min_lr`` (default 0) at ``max_steps``.
+Schedules are pure ``step -> lr`` functions usable inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]
+
+
+def linear_annealing_with_warmup(
+    lr: float, warmup_steps: int, max_steps: int, min_lr: float = 0.0
+) -> Schedule:
+    def f(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.maximum(1.0, float(warmup_steps))
+        warm_lr = lr * step / warm
+        decay_total = jnp.maximum(1.0, float(max_steps - warmup_steps))
+        frac = jnp.clip((step - warmup_steps) / decay_total, 0.0, 1.0)
+        decay_lr = lr + frac * (min_lr - lr)
+        return jnp.where(step < warmup_steps, warm_lr, decay_lr)
+
+    return f
+
+
+def cosine_annealing(
+    lr: float, warmup_steps: int, max_steps: int, min_lr: float = 0.0
+) -> Schedule:
+    def f(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.maximum(1.0, float(warmup_steps))
+        warm_lr = lr * step / warm
+        decay_total = jnp.maximum(1.0, float(max_steps - warmup_steps))
+        frac = jnp.clip((step - warmup_steps) / decay_total, 0.0, 1.0)
+        decay_lr = min_lr + 0.5 * (lr - min_lr) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(step < warmup_steps, warm_lr, decay_lr)
+
+    return f
+
+
+def constant_lr(lr: float, *_, **__) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+_SCHEDULES = {
+    "linearannealingwithwarmup": linear_annealing_with_warmup,
+    "cosineannealing": cosine_annealing,
+    "constant": constant_lr,
+}
+
+
+def build_lr_schedule(optim_cfg: dict[str, Any], max_steps_default: int = 10000) -> Schedule:
+    """Build from the reference's ``model.optim`` block
+    (``hf_llama3_8B_config.yaml:92-107``)."""
+    lr = float(optim_cfg.get("lr", 3e-4))
+    sched = dict(optim_cfg.get("sched", {}) or {})
+    name = str(sched.get("name", "LinearAnnealingWithWarmUp")).lower()
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown LR schedule {sched.get('name')!r}")
+    return _SCHEDULES[name](
+        lr,
+        int(sched.get("warmup_steps", 0)),
+        int(sched.get("max_steps", max_steps_default)),
+        float(sched.get("min_lr", 0.0)),
+    )

@@ -1,0 +1,7 @@
+"""Parallelism substrate: device mesh, sharding rules, ZeRO-1, pipeline, context parallel."""
+
+from neuronx_distributed_training_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    MeshConfig,
+    build_mesh,
+)

@@ -1,0 +1,185 @@
+"""Device-mesh construction — the TPU-native replacement for NxD ``parallel_state``.
+
+The reference framework builds explicit process groups for TP/PP/DP/CP/EP
+(``neuronx_distributed.parallel_state``, consumed at e.g. reference
+``nlp_overrides.py:1274-1285`` and ``base.py:54-57``).  On TPU there is exactly one
+piece of global state instead: a ``jax.sharding.Mesh`` whose named axes *are* the
+parallel groups.  Collectives over a group become XLA collectives over a mesh axis,
+and "which group am I in" questions become PartitionSpecs.
+
+Axis layout (innermost = fastest ICI neighbours):
+
+    (pipe, data, expert, context, model)
+
+- ``model``   — tensor parallelism (and Megatron-style sequence parallelism, which
+                shards activations over the same group; reference
+                ``config_overview.rst:395-401`` ties SP degree == TP degree).
+- ``context`` — context parallelism (ring attention over the sequence axis;
+                reference ``base.py:199``, ``modeling_llama.py:484``).
+- ``expert``  — expert parallelism for MoE.  Carved out of data parallelism the
+                same way NxD carves EP groups from DP ranks: the *true* DP degree
+                is ``data * expert`` for dense parameters and the batch.
+- ``data``    — the remaining data parallelism (ZeRO-1 shards optimizer state over
+                ``data`` × ``expert``).
+- ``pipe``    — pipeline parallelism.
+
+The reference derives ``dp = world / (tp * pp * cp)`` (``base.py:54-57``); we do the
+same and additionally require ``ep | dp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+# Canonical mesh axis names, outermost-first.
+AXES = ("pipe", "data", "expert", "context", "model")
+
+# The compound axis the global batch is sharded over (true data parallelism).
+DATA_AXES = ("data", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Parallel-degree configuration, mirroring the reference's
+    ``distributed_strategy`` YAML block (``config_overview.rst:10-41``)."""
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_model_parallel_size: int = 1
+    sequence_parallel: bool = False
+
+    @classmethod
+    def from_config(cls, cfg: dict[str, Any]) -> "MeshConfig":
+        """Build from a ``distributed_strategy`` config mapping (unknown keys ignored)."""
+        ds = dict(cfg or {})
+        vp = ds.get("virtual_pipeline_model_parallel_size")
+        return cls(
+            tensor_model_parallel_size=int(ds.get("tensor_model_parallel_size", 1)),
+            pipeline_model_parallel_size=int(ds.get("pipeline_model_parallel_size", 1)),
+            virtual_pipeline_model_parallel_size=int(vp) if vp else 1,
+            context_parallel_size=int(ds.get("context_parallel_size", 1)),
+            expert_model_parallel_size=int(ds.get("expert_model_parallel_size", 1)),
+            sequence_parallel=bool(ds.get("sequence_parallel", False)),
+        )
+
+    @property
+    def tp(self) -> int:
+        return self.tensor_model_parallel_size
+
+    @property
+    def pp(self) -> int:
+        return self.pipeline_model_parallel_size
+
+    @property
+    def cp(self) -> int:
+        return self.context_parallel_size
+
+    @property
+    def ep(self) -> int:
+        return self.expert_model_parallel_size
+
+    def validate(self, n_devices: int) -> None:
+        for name, v in (
+            ("tensor_model_parallel_size", self.tp),
+            ("pipeline_model_parallel_size", self.pp),
+            ("context_parallel_size", self.cp),
+            ("expert_model_parallel_size", self.ep),
+            ("virtual_pipeline_model_parallel_size", self.virtual_pipeline_model_parallel_size),
+        ):
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        denom = self.tp * self.pp * self.cp
+        if n_devices % denom != 0:
+            raise ValueError(
+                f"world size {n_devices} not divisible by tp*pp*cp = "
+                f"{self.tp}*{self.pp}*{self.cp} = {denom}"
+            )
+        dp = n_devices // denom
+        if dp % self.ep != 0:
+            raise ValueError(
+                f"data-parallel degree {dp} not divisible by "
+                f"expert_model_parallel_size {self.ep}"
+            )
+        if self.sequence_parallel and self.tp == 1:
+            raise ValueError(
+                "sequence_parallel requires tensor_model_parallel_size > 1 "
+                "(reference megatron_base_model.py:76-80)"
+            )
+
+    def dp_size(self, n_devices: int) -> int:
+        """True data-parallel degree: world / (tp*pp*cp) — reference base.py:54-57."""
+        return n_devices // (self.tp * self.pp * self.cp)
+
+    def shape(self, n_devices: int) -> dict[str, int]:
+        dp = self.dp_size(n_devices)
+        return {
+            "pipe": self.pp,
+            "data": dp // self.ep,
+            "expert": self.ep,
+            "context": self.cp,
+            "model": self.tp,
+        }
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    **kwargs: Any,
+) -> Mesh:
+    """Create the global device mesh for a parallel configuration.
+
+    ``devices`` defaults to ``jax.devices()``.  Uses ``mesh_utils`` for
+    ICI-topology-aware placement on real TPU slices, falling back to a plain
+    reshape (CPU test meshes, odd device counts).
+    """
+    if config is None:
+        config = MeshConfig(**kwargs)
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    config.validate(n)
+    shape = config.shape(n)
+    dims = tuple(shape[a] for a in AXES)
+    assert math.prod(dims) == n
+
+    dev_array = None
+    if devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(dims, devices=list(devices))
+        except Exception:
+            dev_array = None
+    if dev_array is None:
+        dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, AXES)
+
+
+def batch_partition_spec(mesh: Mesh, *, context_sharded_seq: bool = False) -> PartitionSpec:
+    """PartitionSpec for a ``[batch, seq, ...]`` global batch.
+
+    Batch dim shards over the compound DP axis ``(data, expert)``; when context
+    parallelism is active the sequence dim shards over ``context`` (the TPU-native
+    form of the reference's ``get_batch_on_this_context_parallel_rank`` seq-split,
+    ``base.py:199``).
+    """
+    if context_sharded_seq and mesh.shape.get("context", 1) > 1:
+        return PartitionSpec(DATA_AXES, "context")
+    return PartitionSpec(DATA_AXES)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape.get(axis, 1))
+
+
+def dp_degree(mesh: Mesh) -> int:
+    """True data-parallel degree (``data`` × ``expert`` axes)."""
+    return mesh_axis_size(mesh, "data") * mesh_axis_size(mesh, "expert")

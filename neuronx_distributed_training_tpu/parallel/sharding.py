@@ -1,0 +1,99 @@
+"""Sharding rules and constraint helpers.
+
+Where the reference wires explicit NxD parallel layers and hand-written
+scatter/gather calls (``ColumnParallelLinear``/``RowParallelLinear``/
+``scatter_to_sequence_parallel_region`` — reference ``modeling_llama.py:74-78``,
+``modeling_mixtral.py:677-679``), the TPU-native design expresses *all* of
+TP/SP/CP/DP as PartitionSpecs:
+
+- tensor parallelism   = weight specs over the ``model`` axis
+- sequence parallelism = activation seq-dim constrained to ``model`` between blocks
+- context parallelism  = activation seq-dim constrained to ``context``
+- data parallelism     = batch dim over the compound ``(data, expert)`` axis
+
+XLA/GSPMD then inserts exactly the all-gathers/reduce-scatters the reference's
+layers perform by hand.  ``constrain`` is a mesh-aware
+``with_sharding_constraint`` that no-ops when no mesh is active, so every model
+function also runs unsharded (unit tests, single host).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
+
+_STATE = threading.local()
+
+
+def active_mesh() -> Optional[Mesh]:
+    return getattr(_STATE, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    """Activate a mesh for ``constrain``/``named_sharding`` inside the block."""
+    prev = active_mesh()
+    _STATE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _STATE.mesh = prev
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    m = mesh or active_mesh()
+    if m is None:
+        raise RuntimeError("no active mesh; wrap in parallel.sharding.use_mesh(mesh)")
+    return NamedSharding(m, spec)
+
+
+def constrain(x, spec: Optional[P], mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` if a mesh is active, else identity."""
+    if spec is None:
+        return x
+    m = mesh or active_mesh()
+    if m is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
+
+
+def seq_axes(sequence_parallel: bool, context_parallel: bool):
+    """Mesh axes the activation sequence dim is sharded over between blocks.
+
+    CP splits the sequence first (outer), Megatron-SP shards the remainder over
+    the TP group (reference composes them the same way: CP batch-level split at
+    ``base.py:199``, then per-layer SP inside NxD layers)."""
+    axes = []
+    if context_parallel:
+        axes.append("context")
+    if sequence_parallel:
+        axes.append("model")
+    if not axes:
+        return None
+    return tuple(axes) if len(axes) > 1 else axes[0]
+
+
+def act_spec(sequence_parallel: bool = False, context_parallel: bool = False) -> P:
+    """Spec for block-boundary activations ``[batch, seq, hidden]``."""
+    return P(DATA_AXES, seq_axes(sequence_parallel, context_parallel), None)
+
+
+def heads_spec(context_parallel: bool = False) -> P:
+    """Spec for attention-internal activations ``[batch, seq, heads, head_dim]``:
+    heads over ``model`` (TP), seq over ``context`` only (attention needs the
+    full TP-group sequence — the all-gather GSPMD inserts here is the reference's
+    pre-QKV all-gather under SP)."""
+    return P(DATA_AXES, "context" if context_parallel else None, "model", None)
+
+
+def logits_spec(context_parallel: bool = False) -> P:
+    """Spec for lm-head logits ``[batch, seq, vocab]``: vocab over ``model``
+    (the reference's no-gather ColumnParallel lm_head + parallel_cross_entropy,
+    ``modeling_llama.py:808-833``)."""
+    return P(DATA_AXES, "context" if context_parallel else None, "model")

@@ -1,0 +1,2 @@
+"""Training loop and step functions (the replacement for the reference's
+PyTorch-Lightning integration layer, ``nlp_overrides.py`` + ``base.py``)."""

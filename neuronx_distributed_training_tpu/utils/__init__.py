@@ -1,0 +1,1 @@
+"""Utilities: dtype policies, metrics, logging, PRNG discipline."""

@@ -1,0 +1,138 @@
+"""Explicit dtype policies — the TPU-native replacement for the reference's
+env-var precision matrix.
+
+The reference implements precision regimes as process-wide env vars set at launch
+(``XLA_USE_BF16`` / ``XLA_DOWNCAST_BF16`` / ``NEURON_RT_STOCHASTIC_ROUNDING_EN``,
+reference ``training_orchestrator.py:104-137``) and re-read lazily all over the
+code (``base.py:368``, ``modeling_llama.py:242``, ``utils/utils.py:45-50``).
+Here every regime is one explicit, local ``DtypePolicy`` value threaded through
+model/optimizer construction — no global flags, no surprise downcasts.
+
+Regime mapping (reference ``precision:`` YAML block → policy):
+
+- ``mixed_precision`` (master-weights fp32 + fp32 grad accumulation + bf16
+  compute; the reference's recommended regime): params stored fp32, cast to bf16
+  for compute, gradients accumulated fp32, optimizer state fp32.
+- ``bf16SR`` (pure bf16 with stochastic rounding — a Trainium hardware feature):
+  on TPU this maps to bf16 params/compute with fp32 optimizer state; stochastic
+  rounding has no XLA equivalent and fp32 master state is strictly more accurate.
+- ``autocast``: bf16 compute, fp32 params.
+- ``fp32``: everything fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+
+def canonical_dtype(d: Any) -> jnp.dtype:
+    if isinstance(d, str):
+        try:
+            return jnp.dtype(_DTYPES[d.lower()])
+        except KeyError as e:
+            raise ValueError(f"unknown dtype name {d!r}") from e
+    return jnp.dtype(d)
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """Which dtype each role uses.
+
+    - ``param_dtype``: storage dtype of the trainable parameter pytree.
+    - ``compute_dtype``: dtype activations/matmuls run in (params are cast to
+      this inside the forward pass).
+    - ``reduce_dtype``: dtype for cross-device gradient/loss reductions
+      (reference exposes this as ``reduce_dtype``, ``llama_model.py:67-74``).
+    - ``grad_accum_dtype``: dtype microbatch gradients are accumulated in
+      (reference ``fp32_grad_acc``, ``base.py:128-132``).
+    - ``optimizer_dtype``: dtype of optimizer moments / master weights
+      (reference ``adamw_fp32OptState``).
+    """
+
+    param_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    compute_dtype: jnp.dtype = jnp.dtype(jnp.bfloat16)
+    reduce_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    grad_accum_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    optimizer_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    # softmax / norm internals
+    softmax_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+
+    @classmethod
+    def from_precision_config(cls, precision_cfg: Any) -> "DtypePolicy":
+        """Map the reference ``precision:`` YAML block to a policy.
+
+        Accepts either a string regime name or a mapping with a ``type`` key
+        (reference ``config_overview.rst`` precision section, projected to env
+        vars at ``training_orchestrator.py:104-137``).
+        """
+        if precision_cfg is None:
+            return cls()  # mixed_precision default
+        if isinstance(precision_cfg, str):
+            regime, extra = precision_cfg, {}
+        else:
+            cfgd = dict(precision_cfg)
+            regime = cfgd.get("type", "mixed_precision")
+            extra = cfgd
+        regime = str(regime).lower()
+        if regime in ("mixed_precision", "mixed_precisionsr", "mixed"):
+            pol = cls(
+                param_dtype=jnp.dtype(jnp.float32),
+                compute_dtype=jnp.dtype(jnp.bfloat16),
+            )
+        elif regime in ("bf16sr", "bf16"):
+            pol = cls(
+                param_dtype=jnp.dtype(jnp.bfloat16),
+                compute_dtype=jnp.dtype(jnp.bfloat16),
+                grad_accum_dtype=jnp.dtype(jnp.float32),
+            )
+        elif regime == "autocast":
+            pol = cls(
+                param_dtype=jnp.dtype(jnp.float32),
+                compute_dtype=jnp.dtype(jnp.bfloat16),
+            )
+        elif regime in ("fp32", "32", "float32"):
+            pol = cls(
+                param_dtype=jnp.dtype(jnp.float32),
+                compute_dtype=jnp.dtype(jnp.float32),
+            )
+        else:
+            raise ValueError(f"unknown precision regime {regime!r}")
+        overrides = {}
+        for k in (
+            "param_dtype",
+            "compute_dtype",
+            "reduce_dtype",
+            "grad_accum_dtype",
+            "optimizer_dtype",
+            "softmax_dtype",
+        ):
+            if k in extra:
+                overrides[k] = canonical_dtype(extra[k])
+        # master_weights=False means optimizer state follows the param dtype
+        if extra.get("master_weights") is False and "optimizer_dtype" not in overrides:
+            overrides["optimizer_dtype"] = pol.param_dtype
+        return dataclasses.replace(pol, **overrides) if overrides else pol
+
+    def cast_to_compute(self, tree):
+        """Cast a pytree of params/activations to the compute dtype."""
+        import jax
+
+        def _cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.compute_dtype)
+            return x
+
+        return jax.tree_util.tree_map(_cast, tree)

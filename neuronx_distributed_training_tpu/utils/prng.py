@@ -1,0 +1,46 @@
+"""PRNG discipline.
+
+The reference seeds per-pipeline-stage RNGs with ``seed + 100 * pp_rank`` and keeps
+a separate forked RNG tracker for sequence-parallel dropout so seq-sharded dropout
+masks stay consistent (reference ``lightning_modules/model/megatron_init.py:72-82``,
+``transformer.py:2529-2532``).
+
+JAX's splittable threefry keys make this deterministic by construction: we derive
+every random stream from a single base seed with ``jax.random.fold_in`` on stable
+integer tags — no global RNG state, identical results regardless of device count
+or sharding layout.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Stable stream tags (never renumber — checkpoint/reproducibility contract).
+STREAM_PARAMS = 0
+STREAM_DATA = 1
+STREAM_DROPOUT = 2
+STREAM_ROUTER = 3
+
+
+def base_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def stream_key(seed_or_key, stream: int) -> jax.Array:
+    """Key for a named stream (params / data / dropout / router)."""
+    key = seed_or_key
+    if not isinstance(seed_or_key, jax.Array):
+        key = base_key(int(seed_or_key))
+    return jax.random.fold_in(key, stream)
+
+
+def step_key(key: jax.Array, step) -> jax.Array:
+    """Per-training-step key (e.g. dropout); fold in the global step so resume
+    from a checkpoint reproduces the exact same masks."""
+    return jax.random.fold_in(key, step)
+
+
+def stage_key(key: jax.Array, pp_stage: int) -> jax.Array:
+    """Per-pipeline-stage key — the TPU analogue of the reference's
+    ``seed + 100 * pp_rank`` convention (``megatron_init.py:72-82``)."""
+    return jax.random.fold_in(key, 100 * pp_stage)

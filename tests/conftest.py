@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+This is the TPU ecosystem's "fake backend" (SURVEY.md §4): all TP/PP/CP/EP mesh
+logic runs on 8 virtual CPU devices, so the full parallel stack is exercised
+without hardware."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter start (axon TPU plugin),
+# so JAX_PLATFORMS from the env above may be too late — force it post-import.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,116 @@
+import pytest
+
+from neuronx_distributed_training_tpu.config.loader import (
+    batch_schedule,
+    load_config,
+    validate_config,
+)
+
+REFERENCE_STYLE_YAML = """
+name: hf_llama
+model_source: hf
+seed: 1234
+
+trainer:
+  max_steps: 100
+  log_every_n_steps: 10
+  gradient_clip_val: 1.0
+
+exp_manager:
+  exp_dir: /tmp/exp
+  resume_if_exists: True
+  checkpoint_callback_params:
+    save_top_k: 1
+    every_n_train_steps: 10
+    model_parallel_size: ${multiply:${distributed_strategy.tensor_model_parallel_size}, ${distributed_strategy.pipeline_model_parallel_size}}
+
+distributed_strategy:
+  tensor_model_parallel_size: 4
+  pipeline_model_parallel_size: 2
+  zero1: True
+  sequence_parallel: True
+
+data:
+  micro_batch_size: 1
+  global_batch_size: 8
+
+model:
+  num_layers: 4
+  hidden_size: 64
+  optim:
+    name: adamw_fp32OptState
+    lr: 1.5e-4
+    sched:
+      name: LinearAnnealingWithWarmUp
+      warmup_steps: 10
+      max_steps: ${trainer.max_steps}
+
+precision:
+  type: mixed_precision
+
+compiler_flags: '--model-type transformer'
+neuron_rt_exec_timeout: 100
+"""
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    p = tmp_path / "conf.yaml"
+    p.write_text(REFERENCE_STYLE_YAML)
+    return load_config(p)
+
+
+def test_interpolation(cfg):
+    assert cfg.exp_manager.checkpoint_callback_params.model_parallel_size == 8
+    assert cfg.model.optim.sched.max_steps == 100
+
+
+def test_attr_and_path_access(cfg):
+    assert cfg.distributed_strategy.tensor_model_parallel_size == 4
+    assert cfg.get_path("model.optim.lr") == 1.5e-4
+    assert cfg.get_path("model.not.there", "dflt") == "dflt"
+
+
+def test_neuron_keys_tolerated(cfg):
+    # Neuron-only knobs accepted without error
+    assert cfg.compiler_flags == "--model-type transformer"
+
+
+def test_batch_schedule(cfg):
+    # world 16: dp = 16/(4*2) = 2; num_micro = 8/(1*2) = 4  (reference base.py:54-57)
+    sched = batch_schedule(cfg, 16)
+    assert sched == {
+        "dp_size": 2,
+        "num_microbatches": 4,
+        "micro_batch_size": 1,
+        "global_batch_size": 8,
+    }
+
+
+def test_validation_rejects_bad_configs():
+    with pytest.raises(ValueError):
+        load_config(
+            {
+                "distributed_strategy": {"sequence_parallel": True, "tensor_model_parallel_size": 1},
+            }
+        )
+    with pytest.raises(ValueError):
+        load_config(
+            {
+                "distributed_strategy": {
+                    "pipeline_model_parallel_size": 2,
+                    "virtual_pipeline_model_parallel_size": 2,
+                },
+                "model": {"num_layers": 6},
+            }
+        )
+    with pytest.raises(ValueError):
+        load_config({"model": {"moe": {"dropless": True, "capacity_factor": 2.0}}})
+
+
+def test_overrides(tmp_path):
+    p = tmp_path / "conf.yaml"
+    p.write_text(REFERENCE_STYLE_YAML)
+    cfg = load_config(p, overrides={"model.num_layers": 2, "trainer.max_steps": 5})
+    assert cfg.model.num_layers == 2
+    assert cfg.model.optim.sched.max_steps == 5

@@ -1,0 +1,165 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.optim.adamw import (
+    AdamWConfig,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.optim.lr import build_lr_schedule
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+from neuronx_distributed_training_tpu.trainer.step import jit_train_step, make_train_step
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+TINY = llama.LlamaConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_attention_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=64,
+    rope_theta=10000.0,
+    activations_checkpoint_granularity=None,
+)
+
+FP32 = DtypePolicy(
+    param_dtype=jnp.float32, compute_dtype=jnp.float32, softmax_dtype=jnp.float32
+)
+
+
+def _batch(key, cfg, b=4, s=16):
+    ids = jax.random.randint(key, (b, s), 0, cfg.vocab_size)
+    return {"input_ids": ids, "labels": ids}
+
+
+def test_forward_shapes_and_loss():
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(key, TINY, FP32)
+    batch = _batch(jax.random.PRNGKey(1), TINY)
+    loss, _ = llama.forward(params, batch, TINY, FP32)
+    assert loss.shape == ()
+    # random init loss should be near log(vocab)
+    assert abs(float(loss) - np.log(TINY.vocab_size)) < 1.0
+
+
+def test_logits_only_when_no_labels():
+    params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+    batch = {"input_ids": _batch(jax.random.PRNGKey(1), TINY)["input_ids"]}
+    logits, _ = llama.forward(params, batch, TINY, FP32)
+    assert logits.shape == (4, 16, TINY.vocab_size)
+
+
+def test_remat_granularities_same_numerics():
+    key = jax.random.PRNGKey(0)
+    batch = _batch(jax.random.PRNGKey(1), TINY)
+    losses = {}
+    for gran in (None, "selective", "full"):
+        cfg = llama.LlamaConfig(
+            **{**TINY.__dict__, "activations_checkpoint_granularity": gran}
+        )
+        params = llama.init_params(key, cfg, FP32)
+
+        def loss_fn(p):
+            return llama.forward(p, batch, cfg, FP32)[0]
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        losses[gran] = (float(loss), float(grads["embed"]["embedding"].sum()))
+    base = losses[None]
+    for gran in ("selective", "full"):
+        np.testing.assert_allclose(losses[gran][0], base[0], rtol=1e-5)
+        np.testing.assert_allclose(losses[gran][1], base[1], rtol=1e-4)
+
+
+def test_fuse_qkv_param_count_matches_unfused():
+    fused = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+    unfused_cfg = llama.LlamaConfig(**{**TINY.__dict__, "fuse_qkv": False})
+    unfused = llama.init_params(jax.random.PRNGKey(0), unfused_cfg, FP32)
+    n = lambda t: sum(x.size for x in jax.tree_util.tree_leaves(t))
+    assert n(fused) == n(unfused)
+
+
+@pytest.mark.parametrize("tp,sp", [(4, False), (4, True), (8, False)])
+def test_tp_matches_single_device(devices8, tp, sp):
+    """Sharded forward/backward must match the unsharded numerics — the
+    SURVEY.md §4 plan's core parity gate."""
+    cfg = llama.LlamaConfig(**{**TINY.__dict__, "sequence_parallel": sp})
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(key, cfg, FP32)
+    batch = _batch(jax.random.PRNGKey(1), cfg)
+
+    def loss_fn(p, b):
+        return llama.forward(p, b, cfg, FP32)[0]
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params, batch)
+
+    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=tp, sequence_parallel=sp))
+    specs = llama.param_specs(cfg)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    sh_batch = jax.device_put(batch, ns(P(("data", "expert"))))
+    with shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(sh_params, sh_batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for path in (("embed", "embedding"), ("final_norm", "scale")):
+        g, rg = grads, ref_grads
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5)
+
+
+def test_train_step_loss_decreases(devices8):
+    cfg = TINY
+    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))
+    policy = FP32
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
+    opt_state = init_opt_state(params, policy)
+    specs = llama.param_specs(cfg)
+    opt_specs = opt_state_specs(params, specs, mesh, zero1=True, policy=policy)
+
+    def loss_fn(p, batch, step_key):
+        return llama.forward(p, batch, cfg, policy)
+
+    step_fn = make_train_step(
+        loss_fn,
+        AdamWConfig(grad_clip_norm=1.0),
+        build_lr_schedule({"lr": 1e-3, "sched": {"name": "constant"}}),
+        policy,
+        num_microbatches=2,
+        log_param_norm=True,
+    )
+    with shd.use_mesh(mesh):
+        jitted = jit_train_step(step_fn, mesh, specs, opt_specs)
+        batch = _batch(jax.random.PRNGKey(7), cfg, b=8, s=16)
+        losses = []
+        for i in range(8):
+            params, opt_state, metrics = jitted(
+                params, opt_state, batch, jax.random.PRNGKey(i)
+            )
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] - 0.5, losses
+        assert metrics["grad_norm"] > 0
+        assert metrics["param_norm"] > 0
+        assert int(opt_state["step"]) == 8
+
+
+def test_zero1_specs_shard_over_dp(devices8):
+    cfg = TINY
+    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))  # dp=4
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, FP32)
+    specs = llama.param_specs(cfg)
+    opt_specs = opt_state_specs(params, specs, mesh, zero1=True, policy=FP32)
+    # embedding moments get dp sharding on the hidden dim
+    mu_spec = opt_specs["mu"]["embed"]["embedding"]
+    assert "data" in str(mu_spec)
+    # param specs untouched
+    assert specs["embed"]["embedding"] == P("model", None)

@@ -1,0 +1,71 @@
+import jax
+import pytest
+
+from neuronx_distributed_training_tpu.parallel.mesh import (
+    AXES,
+    MeshConfig,
+    batch_partition_spec,
+    build_mesh,
+    dp_degree,
+)
+
+
+def test_axes_order():
+    assert AXES == ("pipe", "data", "expert", "context", "model")
+
+
+def test_default_mesh_is_all_data(devices8):
+    mesh = build_mesh()
+    assert mesh.shape["data"] == 8
+    assert all(mesh.shape[a] == 1 for a in AXES if a != "data")
+    assert dp_degree(mesh) == 8
+
+
+@pytest.mark.parametrize(
+    "tp,pp,cp,ep",
+    [(2, 1, 1, 1), (4, 2, 1, 1), (2, 2, 2, 1), (2, 1, 1, 2), (8, 1, 1, 1), (1, 1, 1, 8)],
+)
+def test_mesh_shapes(devices8, tp, pp, cp, ep):
+    cfg = MeshConfig(
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        context_parallel_size=cp,
+        expert_model_parallel_size=ep,
+    )
+    mesh = build_mesh(cfg)
+    assert mesh.shape["model"] == tp
+    assert mesh.shape["pipe"] == pp
+    assert mesh.shape["context"] == cp
+    assert mesh.shape["expert"] == ep
+    # dp derivation matches the reference rule world/(tp*pp*cp)
+    assert cfg.dp_size(8) == 8 // (tp * pp * cp)
+    assert dp_degree(mesh) == cfg.dp_size(8)
+
+
+def test_invalid_mesh_rejected(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(tensor_model_parallel_size=3))
+    with pytest.raises(ValueError):
+        # ep must divide dp
+        build_mesh(MeshConfig(tensor_model_parallel_size=4, expert_model_parallel_size=4))
+    with pytest.raises(ValueError):
+        MeshConfig(sequence_parallel=True).validate(8)
+
+
+def test_from_config_dict():
+    cfg = MeshConfig.from_config(
+        {
+            "tensor_model_parallel_size": 4,
+            "pipeline_model_parallel_size": 2,
+            "virtual_pipeline_model_parallel_size": None,
+            "zero1": True,
+            "kv_replicator": 4,
+        }
+    )
+    assert cfg.tp == 4 and cfg.pp == 2 and cfg.virtual_pipeline_model_parallel_size == 1
+
+
+def test_batch_partition_spec(devices8):
+    mesh = build_mesh(MeshConfig(context_parallel_size=2))
+    spec = batch_partition_spec(mesh, context_sharded_seq=True)
+    assert spec == jax.sharding.PartitionSpec(("data", "expert"), "context")

@@ -1,0 +1,149 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_training_tpu.ops import attention as attn_ops
+from neuronx_distributed_training_tpu.ops import cross_entropy as ce_ops
+from neuronx_distributed_training_tpu.ops import linear as linear_ops
+from neuronx_distributed_training_tpu.ops import norm as norm_ops
+from neuronx_distributed_training_tpu.ops import rope as rope_ops
+
+
+def test_rms_norm_matches_numpy():
+    params, _ = norm_ops.init_rms_norm(16)
+    params["scale"] = jnp.asarray(np.random.RandomState(0).randn(16), jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 16), jnp.float32)
+    out = norm_ops.apply_rms_norm(params, x, eps=1e-5)
+    xn = np.asarray(x, np.float64)
+    expected = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-5) * np.asarray(params["scale"])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-5, atol=2e-5)
+
+
+def test_rms_norm_upcasts_bf16():
+    params, _ = norm_ops.init_rms_norm(128)
+    x = jnp.ones((1, 4, 128), jnp.bfloat16) * 3.0
+    out = norm_ops.apply_rms_norm(params, x)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), 1.0, rtol=1e-2)
+
+
+def test_rope_rotation_properties():
+    # rotating by position p then attending q.k should depend only on p_q - p_k
+    d = 8
+    inv = rope_ops.rope_frequencies(d, theta=10000.0)
+    q = jnp.asarray(np.random.RandomState(0).randn(1, 4, 1, d), jnp.float32)
+    pos = jnp.arange(4)[None, :]
+    cos, sin = rope_ops.rope_cos_sin(pos, inv)
+    q_rot = rope_ops.apply_rope(q, cos, sin)
+    # norm preserved
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(q_rot), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(q_rot[0, 0]), np.asarray(q[0, 0]), rtol=1e-6)
+
+
+def test_rope_relative_position_invariance():
+    d = 16
+    inv = rope_ops.rope_frequencies(d)
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(1, 1, 1, d), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 1, 1, d), jnp.float32)
+
+    def score(pq, pk):
+        cq, sq = rope_ops.rope_cos_sin(jnp.asarray([[pq]]), inv)
+        ck, sk = rope_ops.rope_cos_sin(jnp.asarray([[pk]]), inv)
+        return float(
+            jnp.sum(rope_ops.apply_rope(q, cq, sq) * rope_ops.apply_rope(k, ck, sk))
+        )
+
+    assert score(5, 3) == pytest.approx(score(12, 10), rel=1e-5)
+
+
+def test_core_attention_matches_numpy_softmax():
+    b, s, h, d = 2, 8, 2, 4
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    out = attn_ops.core_attention(q, k, v, causal=True)
+
+    qn, kn, vn = (np.asarray(x, np.float64) for x in (q, k, v))
+    scores = np.einsum("bqhd,bkhd->bhqk", qn, kn) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask, scores, -np.inf)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    expected = np.einsum("bhqk,bkhd->bqhd", probs, vn)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_gqa_repeat_kv_equivalence():
+    b, s, d = 1, 6, 4
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(b, s, 4, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, s, 2, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, s, 2, d), jnp.float32)
+    out = attn_ops.core_attention(q, k, v)
+    out_expanded = attn_ops.core_attention(q, attn_ops.repeat_kv(k, 2), attn_ops.repeat_kv(v, 2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_expanded), rtol=1e-6)
+
+
+def test_sliding_window_mask():
+    bias = attn_ops.causal_mask_bias(4, 4, sliding_window=2)
+    visible = np.asarray(bias) == 0
+    expected = np.array(
+        [
+            [1, 0, 0, 0],
+            [1, 1, 0, 0],
+            [0, 1, 1, 0],
+            [0, 0, 1, 1],
+        ],
+        bool,
+    )
+    np.testing.assert_array_equal(visible, expected)
+
+
+def test_cross_entropy_matches_scipy():
+    b, s, v = 2, 4, 11
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(b, s, v), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, (b, s)))
+    loss = ce_ops.cross_entropy_loss(logits, labels)
+    ln = np.asarray(logits, np.float64)
+    lse = np.log(np.exp(ln).sum(-1))
+    ll = np.take_along_axis(ln, np.asarray(labels)[..., None], -1)[..., 0]
+    np.testing.assert_allclose(float(loss), (lse - ll).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_mask():
+    logits = jnp.zeros((1, 4, 5))
+    labels = jnp.asarray([[1, 2, -100, 3]])
+    loss = ce_ops.cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(5.0), rtol=1e-6)
+    masked = ce_ops.cross_entropy_loss(
+        logits, labels, loss_mask=jnp.asarray([[1.0, 0.0, 1.0, 1.0]])
+    )
+    np.testing.assert_allclose(float(masked), np.log(5.0), rtol=1e-6)
+
+
+def test_logprobs_from_logits():
+    logits = jnp.asarray(np.random.RandomState(0).randn(1, 3, 7), jnp.float32)
+    labels = jnp.asarray([[0, 3, 6]])
+    lp = ce_ops.logprobs_from_logits(logits, labels)
+    ref = np.log(
+        np.take_along_axis(
+            np.exp(np.asarray(logits)) / np.exp(np.asarray(logits)).sum(-1, keepdims=True),
+            np.asarray(labels)[..., None],
+            -1,
+        )[..., 0]
+    )
+    np.testing.assert_allclose(np.asarray(lp), ref, rtol=1e-4)
+
+
+def test_vocab_padding():
+    assert linear_ops.pad_vocab_size(32000, 128, 4) == 32256
+    assert linear_ops.pad_vocab_size(512, 128, 4) == 512
