@@ -1,0 +1,191 @@
+"""Benchmark: Llama-3-8B-shaped pretraining step on one chip.
+
+Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}.
+The driver-designated metric (BASELINE.json) is Llama-3-8B pretrain MFU with a
+north star of >= 45% MFU; vs_baseline is measured_mfu / 45%.
+
+On TPU the model is Llama-3-8B per-layer shapes (hidden 4096 / ffn 14336 /
+32 heads / 8 KV heads / vocab 128256 / seq 8192) with the layer count scaled to
+fit one chip — MFU is per-layer-shape-bound, so this measures the same thing the
+full 32-layer multi-chip run would.  On CPU it shrinks to a smoke config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.optim.adamw import (
+    AdamWConfig,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.optim.lr import constant_lr
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+from neuronx_distributed_training_tpu.trainer.step import jit_train_step, make_train_step
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+from neuronx_distributed_training_tpu.utils import perf
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def has_flash() -> bool:
+    try:
+        from neuronx_distributed_training_tpu.ops import flash_attention  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--mbs", type=int, default=1)
+    ap.add_argument("--attn", choices=["auto", "core", "flash"], default="auto")
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if args.attn == "auto":
+        attn_impl = "flash" if (on_tpu and has_flash()) else "core"
+    else:
+        attn_impl = args.attn
+
+    if on_tpu:
+        # Flash attention handles seq 8192; naive core attention's O(s^2)
+        # transients need the shorter default on small-HBM chips.
+        seq = args.seq or (8192 if attn_impl == "flash" else 4096)
+        h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
+        if args.layers:
+            layers = args.layers
+        else:
+            # Auto-size the layer count to HBM: pure-bf16 regime costs
+            # ~6 bytes/param (param + m + v) plus transient bf16 grads (2).
+            try:
+                hbm = dev.memory_stats()["bytes_limit"]
+            except Exception:
+                hbm = 16 << 30
+            per_layer = h * (nh + 2 * nkv) * (h // nh) + nh * (h // nh) * h + 3 * h * ffn
+            vocab_params = 2 * vocab * h
+            budget_params = hbm * 0.60 / 8.0
+            layers = max(1, min(32, int((budget_params - vocab_params) // per_layer)))
+        cfg = llama.LlamaConfig(
+            vocab_size=vocab,
+            hidden_size=h,
+            intermediate_size=ffn,
+            num_layers=layers,
+            num_attention_heads=nh,
+            num_kv_heads=nkv,
+            max_position_embeddings=seq,
+            rope_theta=500000.0,
+            fuse_qkv=True,
+            attention_impl=attn_impl,
+            activations_checkpoint_granularity="selective",
+        )
+    else:
+        seq = args.seq or 512
+        cfg = llama.LlamaConfig(
+            vocab_size=1024,
+            hidden_size=256,
+            intermediate_size=704,
+            num_layers=args.layers or 2,
+            num_attention_heads=8,
+            num_kv_heads=4,
+            max_position_embeddings=seq,
+            attention_impl="core" if attn_impl == "auto" else attn_impl,
+        )
+        args.steps = min(args.steps, 4)
+        args.warmup = min(args.warmup, 1)
+
+    # Pure-bf16 regime on TPU (the reference's bf16+SR regime,
+    # training_orchestrator.py precision matrix) — 6 bytes/param keeps the
+    # Llama3-8B layer shapes + full vocab resident on a small-HBM chip.
+    policy = (
+        DtypePolicy.from_precision_config(
+            {"type": "bf16SR", "optimizer_dtype": "bf16", "grad_accum_dtype": "bf16"}
+        )
+        if on_tpu
+        else DtypePolicy.from_precision_config("mixed_precision")
+    )
+    mesh = build_mesh(MeshConfig(), devices=[dev])
+    log(f"bench: device={dev.device_kind} layers={cfg.num_layers} seq={seq} "
+        f"mbs={args.mbs} attn={cfg.attention_impl}")
+
+    pspecs = llama.param_specs(cfg)
+    with mesh, shd.use_mesh(mesh):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
+        ns = functools.partial(NamedSharding, mesh)
+        put = lambda tree, specs: jax.device_put(
+            tree, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        params = put(params, pspecs)
+        opt_state = init_opt_state(params, policy)
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy)
+        opt_state = put(opt_state, ospecs)
+
+        def loss_fn(p, batch, step_key):
+            return llama.forward(p, batch, cfg, policy)
+
+        step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy)
+        jstep = jit_train_step(step, mesh, pspecs, ospecs)
+
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (args.mbs, seq), 0, cfg.vocab_size, dtype=jnp.int32
+        )
+        batch = {"input_ids": ids, "labels": ids}
+        batch = jax.device_put(batch, ns(P(("data", "expert"))))
+        key = jax.random.PRNGKey(2)
+
+        t_compile = time.perf_counter()
+        for _ in range(args.warmup):
+            params, opt_state, metrics = jstep(params, opt_state, batch, key)
+        jax.block_until_ready(metrics)
+        log(f"bench: warmup done in {time.perf_counter() - t_compile:.1f}s "
+            f"loss={float(metrics['loss']):.4f}")
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, opt_state, metrics = jstep(params, opt_state, batch, key)
+        jax.block_until_ready(metrics)
+        dt = (time.perf_counter() - t0) / args.steps
+
+    tokens_per_step = args.mbs * seq
+    tokens_per_sec = tokens_per_step / dt
+    fwd_ft = perf.flops_for_config(cfg, seq)
+    step_ft = perf.train_step_flops_per_token(fwd_ft)
+    peak = perf.detect_peak_tflops(dev)
+    mfu = perf.mfu(tokens_per_sec, step_ft, peak)
+    log(f"bench: {dt * 1e3:.1f} ms/step, {tokens_per_sec:,.0f} tok/s/chip, "
+        f"MFU {100 * mfu:.1f}% (peak {peak} TF)")
+
+    print(json.dumps({
+        "metric": "llama3_8B_pretrain_mfu",
+        "value": round(100 * mfu, 2),
+        "unit": "percent_mfu",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "ms_per_step": round(dt * 1e3, 2),
+        "device": dev.device_kind,
+        "attn_impl": cfg.attention_impl,
+        "num_layers": cfg.num_layers,
+        "seq_len": seq,
+    }))
+
+
+if __name__ == "__main__":
+    main()

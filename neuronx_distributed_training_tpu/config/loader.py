@@ -14,6 +14,7 @@ with a warning, so unmodified reference configs still load.
 
 from __future__ import annotations
 
+import copy
 import logging
 import math
 import re
@@ -123,7 +124,7 @@ def load_config(source: str | Path | Mapping, overrides: Mapping | None = None) 
         with open(source) as f:
             raw = yaml.safe_load(f)
     else:
-        raw = {k: v for k, v in source.items()}
+        raw = copy.deepcopy(dict(source))  # never mutate the caller's mapping
     if raw is None:
         raw = {}
     if overrides:

@@ -253,7 +253,6 @@ def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy):
         sliding_window=cfg.sliding_window,
         softmax_dtype=policy.softmax_dtype,
     )
-    out = checkpoint_name(out, "attn_out")
     out = out.reshape(b, s, nh * d)
     # RowParallel o_proj; reduce(-scatter under SP) inserted by GSPMD
     # (reference modeling_llama.py:475)
@@ -282,9 +281,11 @@ def _remat_policy(granularity: Optional[str]):
     if granularity == "full":
         return jax.checkpoint_policies.nothing_saveable
     if granularity == "selective":
-        # recompute attention internals only — the reference's
+        # recompute the O(s^2) attention internals only — the reference's
         # activations_checkpoint_recompute: [CoreAttention]
-        return jax.checkpoint_policies.save_anything_except_these_names("attn_out")
+        return jax.checkpoint_policies.save_anything_except_these_names(
+            "attn_scores", "attn_probs"
+        )
     return None
 
 

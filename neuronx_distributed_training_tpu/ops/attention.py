@@ -21,8 +21,22 @@ from __future__ import annotations
 
 from typing import Optional
 
+import logging
+
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+logger = logging.getLogger(__name__)
+_warned: set = set()
+
+
+def _warn_fallback(impl: str) -> None:
+    if impl not in _warned:
+        _warned.add(impl)
+        logger.warning(
+            "%s attention kernel unavailable; falling back to core attention", impl
+        )
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -84,7 +98,12 @@ def core_attention(
         )
     if bias is not None:
         scores = scores + bias.astype(softmax_dtype)
+    # Tag the O(s^2) internals so the "selective" remat policy recomputes them
+    # in backward instead of saving them (the reference's
+    # activations_checkpoint_recompute: [CoreAttention]).
+    scores = checkpoint_name(scores, "attn_scores")
     probs = jax.nn.softmax(scores, axis=-1)
+    probs = checkpoint_name(probs, "attn_probs")
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 
@@ -101,17 +120,23 @@ def attention(
     softmax_dtype=jnp.float32,
 ) -> jax.Array:
     """Dispatch mirroring the reference's flash/ring/Core selection
-    (``modeling_llama.py:482-489``)."""
+    (``modeling_llama.py:482-489``).  Falls back to ``core_attention`` (with a
+    one-time warning) if the requested kernel is unavailable, so reference
+    configs with ``fusions.flash_attention: true`` still run."""
     if impl == "flash":
-        from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
-
-        return flash_attention(
-            q, k, v, causal=causal, sliding_window=sliding_window
-        )
+        try:
+            from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
+        except ImportError:
+            _warn_fallback("flash")
+        else:
+            return flash_attention(q, k, v, causal=causal, sliding_window=sliding_window)
     if impl == "ring":
-        from neuronx_distributed_training_tpu.parallel.ring_attention import ring_attention
-
-        return ring_attention(q, k, v, causal=causal)
+        try:
+            from neuronx_distributed_training_tpu.parallel.ring_attention import ring_attention
+        except ImportError:
+            _warn_fallback("ring")
+        else:
+            return ring_attention(q, k, v, causal=causal)
     return core_attention(
         q,
         k,

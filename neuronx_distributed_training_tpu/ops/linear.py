@@ -58,8 +58,8 @@ def init_linear(
         raise ValueError(f"unknown shard mode {shard!r}")
     specs = {"w": wspec}
     if use_bias:
-        params["b"] = jnp.zeros((out_dim,), dtype)
-        specs["b"] = bspec
+        params["bias"] = jnp.zeros((out_dim,), dtype)
+        specs["bias"] = bspec
     return params, specs
 
 
@@ -69,8 +69,8 @@ def apply_linear(params, x: jax.Array, *, compute_dtype=None) -> jax.Array:
         w = w.astype(compute_dtype)
         x = x.astype(compute_dtype)
     y = x @ w
-    if "b" in params:
-        b = params["b"]
+    if "bias" in params:
+        b = params["bias"]
         y = y + (b.astype(y.dtype) if compute_dtype is not None else b)
     return y
 

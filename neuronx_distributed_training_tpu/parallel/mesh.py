@@ -30,12 +30,15 @@ same and additionally require ``ep | dp``.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Any, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
+
+logger = logging.getLogger(__name__)
 
 # Canonical mesh axis names, outermost-first.
 AXES = ("pipe", "data", "expert", "context", "model")
@@ -156,7 +159,12 @@ def build_mesh(
             from jax.experimental import mesh_utils
 
             dev_array = mesh_utils.create_device_mesh(dims, devices=list(devices))
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — fall back, but loudly: a
+            # topology-oblivious mesh silently degrades collective bandwidth.
+            logger.warning(
+                "mesh_utils.create_device_mesh(%s) failed (%s); falling back to "
+                "plain reshape — ICI-topology-aware placement lost", dims, e
+            )
             dev_array = None
     if dev_array is None:
         dev_array = np.asarray(devices).reshape(dims)

@@ -163,3 +163,26 @@ def test_zero1_specs_shard_over_dp(devices8):
     assert "data" in str(mu_spec)
     # param specs untouched
     assert specs["embed"]["embedding"] == P("model", None)
+
+
+def test_param_specs_structure_matches_params():
+    """Guard against _layer_specs drifting from _init_layer (they are two
+    sources of the same knowledge — a mismatch breaks jit sharding silently)."""
+    import jax
+    from jax.sharding import PartitionSpec
+    from neuronx_distributed_training_tpu.models import llama
+
+    for fuse_qkv in (True, False):
+        for tie in (True, False):
+            cfg = llama.LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+                num_attention_heads=4, num_kv_heads=2, fuse_qkv=fuse_qkv,
+                tie_word_embeddings=tie,
+            )
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            specs = llama.param_specs(cfg)
+            ps = jax.tree_util.tree_structure(params)
+            ss = jax.tree_util.tree_structure(
+                specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+            )
+            assert ps == ss, f"fuse_qkv={fuse_qkv} tie={tie}: {ps} != {ss}"
