@@ -154,15 +154,22 @@ def main() -> None:
         t_compile = time.perf_counter()
         for _ in range(args.warmup):
             params, opt_state, metrics = jstep(params, opt_state, batch, key)
-        jax.block_until_ready(metrics)
+        # A host scalar fetch is the only reliable execution fence on remote
+        # (tunnelled) TPU backends — block_until_ready alone doesn't flush.
         log(f"bench: warmup done in {time.perf_counter() - t_compile:.1f}s "
             f"loss={float(metrics['loss']):.4f}")
 
+        # Measure fetch round-trip on a settled but never-fetched buffer
+        # (loss was already fetched above and is host-cached).
+        t_rtt = time.perf_counter()
+        _ = float(metrics["grad_norm"])
+        rtt = time.perf_counter() - t_rtt
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, opt_state, metrics = jstep(params, opt_state, batch, key)
-        jax.block_until_ready(metrics)
-        dt = (time.perf_counter() - t0) / args.steps
+        _ = float(metrics["loss"])  # fence: forces the whole dependent chain
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9) / args.steps
+        log(f"bench: fetch rtt {rtt * 1e3:.0f} ms")
 
     tokens_per_step = args.mbs * seq
     tokens_per_sec = tokens_per_step / dt

@@ -129,7 +129,9 @@ def attention(
         except ImportError:
             _warn_fallback("flash")
         else:
-            return flash_attention(q, k, v, causal=causal, sliding_window=sliding_window)
+            return flash_attention(
+                q, k, v, causal=causal, sliding_window=sliding_window, q_offset=q_offset
+            )
     if impl == "ring":
         try:
             from neuronx_distributed_training_tpu.parallel.ring_attention import ring_attention

@@ -1,0 +1,91 @@
+"""Numerics gate for the Pallas flash-attention kernel: forward and gradients
+must match core_attention (the reference-numerics implementation) in
+interpreter mode on CPU (SURVEY.md §4 plan item (a))."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuronx_distributed_training_tpu.ops.attention import core_attention
+from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
+
+
+def _make_qkv(key, b, sq, skv, nh, nkv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, nh, d), dtype)
+    k = jax.random.normal(kk, (b, skv, nkv, d), dtype)
+    v = jax.random.normal(kv, (b, skv, nkv, d), dtype)
+    return q, k, v
+
+
+CASES = [
+    # (sq, skv, nh, nkv, window, causal)
+    (256, 256, 2, 2, None, True),     # MHA causal
+    (256, 256, 4, 2, None, True),     # GQA
+    (256, 512, 2, 1, None, False),    # cross-length, non-causal, MQA
+    (256, 256, 2, 2, 128, True),      # sliding window
+]
+
+
+@pytest.mark.parametrize("sq,skv,nh,nkv,window,causal", CASES)
+def test_flash_matches_core_fwd_and_grad(sq, skv, nh, nkv, window, causal):
+    q, k, v = _make_qkv(jax.random.PRNGKey(0), 2, sq, skv, nh, nkv, 128)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, sliding_window=window,
+            block_q=128, block_kv=128, interpret=True,
+        )
+        return jnp.sum(o * o)
+
+    def loss_core(q, k, v):
+        o = core_attention(q, k, v, causal=causal, sliding_window=window)
+        return jnp.sum(o * o)
+
+    (lf, gf) = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    (lc, gc) = jax.value_and_grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+    assert jnp.allclose(lf, lc, rtol=2e-4), (lf, lc)
+    for a, b_, name in zip(gf, gc, "qkv"):
+        err = jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9)
+        assert err < 2e-3, f"d{name} rel err {err}"
+
+
+def test_flash_untileable_falls_back():
+    # head_dim 64 is not lane-aligned -> silently uses core attention
+    q, k, v = _make_qkv(jax.random.PRNGKey(1), 1, 64, 64, 2, 2, 64)
+    o = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = core_attention(q, k, v, causal=True)
+    assert jnp.allclose(o, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_q_offset_matches_core():
+    # context-parallel shard: queries are rows 128..255 of a 256-long sequence
+    q, k, v = _make_qkv(jax.random.PRNGKey(2), 1, 128, 256, 2, 2, 128)
+    o = flash_attention(
+        q, k, v, causal=True, q_offset=128, block_q=128, block_kv=128, interpret=True
+    )
+    ref = core_attention(q, k, v, causal=True, q_offset=128)
+    err = jnp.max(jnp.abs(o - ref))
+    assert err < 1e-4, err
+
+
+def test_flash_bf16_grad_tolerance():
+    """Pin bf16 gradient accuracy (dq uses the same fp32 ds accumulation as
+    dk/dv — a downcast there showed up as dq-only error growth)."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(3), 1, 256, 256, 4, 2, 128, jnp.bfloat16)
+
+    def lf(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=128, block_kv=128,
+                            interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def lc(q, k, v):
+        o = core_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(lc, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gc, "qkv"):
+        a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+        err = jnp.max(jnp.abs(a32 - b32)) / (jnp.max(jnp.abs(b32)) + 1e-9)
+        assert err < 0.05, f"d{name} bf16 rel err {err}"
