@@ -159,16 +159,25 @@ def main() -> None:
         log(f"bench: warmup done in {time.perf_counter() - t_compile:.1f}s "
             f"loss={float(metrics['loss']):.4f}")
 
-        # Measure fetch round-trip on a settled but never-fetched buffer
-        # (loss was already fetched above and is host-cached).
-        t_rtt = time.perf_counter()
-        _ = float(metrics["grad_norm"])
-        rtt = time.perf_counter() - t_rtt
+        # Measure fetch round-trip on settled buffers: min of several samples so
+        # a one-off connection-setup stall can't dominate the correction.
+        rtts = []
+        # only never-fetched buffers: a fetched jax.Array caches its host value,
+        # so re-fetching "loss" (read at the warmup log) measures ~0
+        for m in ("grad_norm", "lr"):
+            t_rtt = time.perf_counter()
+            _ = float(metrics[m])
+            rtts.append(time.perf_counter() - t_rtt)
+        rtt = min(rtts)
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, opt_state, metrics = jstep(params, opt_state, batch, key)
         _ = float(metrics["loss"])  # fence: forces the whole dependent chain
-        dt = max(time.perf_counter() - t0 - rtt, 1e-9) / args.steps
+        elapsed = time.perf_counter() - t0
+        # the rtt correction must stay a correction — never let it swallow the
+        # measurement and report a fantasy number
+        rtt = min(rtt, 0.1 * elapsed)
+        dt = (elapsed - rtt) / args.steps
         log(f"bench: fetch rtt {rtt * 1e3:.0f} ms")
 
     tokens_per_step = args.mbs * seq

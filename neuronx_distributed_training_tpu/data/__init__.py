@@ -1,0 +1,23 @@
+"""Data layer — host-side pipeline feeding fixed-shape sharded global batches.
+
+TPU-native re-design of the reference's ``lightning_modules/data/`` package
+(BaseDataModule / HFDataModule / ModelAlignmentDataModule + datasets/):
+pure-Python/numpy pipeline, deterministic per-DP-shard sampling, consumed-samples
+bookkeeping, greedy packing and fixed-length padding (all batches same shape —
+the reference's load-bearing rule for XLA graph reuse).
+"""
+
+from neuronx_distributed_training_tpu.data.sampler import (  # noqa: F401
+    PretrainingSampler,
+    RandomSampler,
+)
+from neuronx_distributed_training_tpu.data.packing import (  # noqa: F401
+    pack_sequences,
+    pad_sequences,
+)
+from neuronx_distributed_training_tpu.data.loader import (  # noqa: F401
+    DataModule,
+    HFDataModule,
+    SyntheticDataModule,
+    process_global_batch,
+)

@@ -1,0 +1,172 @@
+"""DataModules: dataset -> fixed-shape sharded global batches.
+
+Re-design of the reference's ``BaseDataModule``/``HFDataModule``
+(``data/base.py``, ``hf_data_module.py``): a DataModule owns a dataset + sampler
++ batch math and yields device-ready global batches.  Differences from the
+reference, by design:
+
+- no torch DataLoader / MpDeviceLoader: batches are numpy on host, transferred
+  once per step via ``jax.make_array_from_process_local_data`` (multi-host
+  correct — each process contributes its DP-local rows);
+- the global batch goes to device **whole**; microbatching happens inside the
+  jitted step (``trainer/step.py:microbatch_split``), where the reference loops
+  microbatches on host (``base.py:330-350``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX
+from neuronx_distributed_training_tpu.data.sampler import PretrainingSampler, RandomSampler
+from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
+
+
+def process_global_batch(
+    batch: dict[str, np.ndarray],
+    *,
+    input_names: Sequence[str] = ("input_ids", "labels", "loss_mask"),
+    pad_id: Optional[int] = None,
+    derive_loss_mask: bool = True,
+) -> dict[str, np.ndarray]:
+    """Filter to model ``input_names`` and derive missing ``labels``/``loss_mask``
+    (reference ``hf_data_module.py:49-58``, ``model_alignment_data_module.py:239-255``).
+
+    ``pad_id`` must only be set when the dataset actually pads with that token —
+    it additionally masks those positions out of the loss.  Leave ``None`` for
+    packed/unpadded data where the pad token id is a legitimate vocab token.
+    """
+    out: dict[str, np.ndarray] = {}
+    ids = np.asarray(batch["input_ids"], dtype=np.int32)
+    out["input_ids"] = ids
+    if "labels" in input_names:
+        labels = np.asarray(batch.get("labels", ids), dtype=np.int32)
+        out["labels"] = labels
+        if "loss_mask" in input_names:
+            if "loss_mask" in batch:
+                out["loss_mask"] = np.asarray(batch["loss_mask"], dtype=np.float32)
+            elif derive_loss_mask:
+                mask = labels != IGNORE_INDEX
+                if pad_id is not None:
+                    mask &= ids != pad_id
+                out["loss_mask"] = mask.astype(np.float32)
+    for k in input_names:
+        if k not in out and k in batch:
+            out[k] = np.asarray(batch[k])
+    return out
+
+
+def shard_batch(
+    batch: dict[str, np.ndarray], mesh: Mesh, spec: Optional[P] = None
+) -> dict[str, jax.Array]:
+    """Host numpy global batch -> sharded device arrays.
+
+    Each process passes its **process-local** rows; under one process this is
+    the whole batch.  Replaces the reference's MpDeviceLoader host->device move
+    (``base.py:330-350``).
+    """
+    spec = spec if spec is not None else P(DATA_AXES)
+    sharding = NamedSharding(mesh, spec)
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
+    }
+
+
+class DataModule:
+    """Base: sampler + gather + batch math.  Subclasses implement ``fetch_rows``."""
+
+    def __init__(
+        self,
+        total_samples: int,
+        global_batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 1234,
+        consumed_samples: int = 0,
+        input_names: Sequence[str] = ("input_ids", "labels", "loss_mask"),
+        pad_id: Optional[int] = None,
+    ):
+        self.global_batch_size = global_batch_size
+        self.input_names = tuple(input_names)
+        self.pad_id = pad_id
+        if shuffle:
+            self.sampler: Any = RandomSampler(
+                total_samples, global_batch_size, seed=seed, consumed_samples=consumed_samples
+            )
+        else:
+            self.sampler = PretrainingSampler(
+                total_samples, global_batch_size, consumed_samples=consumed_samples
+            )
+
+    @property
+    def consumed_samples(self) -> int:
+        """Single integer of resume state (reference ``data/base.py:33-47``)."""
+        return self.sampler.consumed_samples
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def global_batches(self) -> Iterator[dict[str, np.ndarray]]:
+        """Yield processed host-side global batches (numpy)."""
+        for idx in self.sampler:
+            yield process_global_batch(
+                self.fetch_rows(idx), input_names=self.input_names, pad_id=self.pad_id
+            )
+
+    def sharded_batches(
+        self, mesh: Mesh, spec: Optional[P] = None
+    ) -> Iterator[dict[str, jax.Array]]:
+        for batch in self.global_batches():
+            yield shard_batch(batch, mesh, spec)
+
+
+class HFDataModule(DataModule):
+    """HF-datasets-on-disk module (reference ``hf_data_module.py:15-44``:
+    ``load_from_disk`` + per-DP sharding, fixed-length rows expected)."""
+
+    def __init__(self, dataset_or_path: Any, global_batch_size: int, **kw: Any):
+        if isinstance(dataset_or_path, (str, os.PathLike)):
+            import datasets  # lazy: heavy import
+
+            self.dataset = datasets.load_from_disk(str(dataset_or_path))
+        else:
+            self.dataset = dataset_or_path
+        super().__init__(len(self.dataset), global_batch_size, **kw)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        rows = self.dataset[[int(i) for i in idx]]
+        return {k: np.asarray(v) for k, v in rows.items() if not k.startswith("__")}
+
+
+class SyntheticDataModule(DataModule):
+    """Deterministic synthetic causal-LM data (for benchmarks, smoke tests, and
+    the reference's TRAIN_ITERS-style short-run integration tests)."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        seq_len: int,
+        global_batch_size: int,
+        *,
+        total_samples: int = 1 << 16,
+        seed: int = 0,
+        **kw: Any,
+    ):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self._seed = seed
+        super().__init__(total_samples, global_batch_size, **kw)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        # content is a pure function of the row index -> reproducible across
+        # hosts and resumes without storing anything
+        rows = np.empty((len(idx), self.seq_len), dtype=np.int32)
+        for r, i in enumerate(idx):
+            rng = np.random.Generator(np.random.PCG64(self._seed * 1_000_003 + int(i)))
+            rows[r] = rng.integers(0, self.vocab_size, self.seq_len, dtype=np.int32)
+        return {"input_ids": rows}

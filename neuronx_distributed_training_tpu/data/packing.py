@@ -1,0 +1,130 @@
+"""Sequence packing and fixed-length padding.
+
+Re-design of the reference's ``datasets/ConcatDataset.py`` (greedy packing to
+``chunk_size`` with EOS separators, overflow-record drop, reference
+``ConcatDataset.py:7-81``) and ``datasets/PaddedDataset.py`` (fixed-length
+padding so every batch has the same shape → one XLA graph; DPO variant pads
+chosen/rejected/prompt keys with left-padded prompts, reference
+``PaddedDataset.py:9-103``) as numpy batch transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+IGNORE_INDEX = -100  # loss-masked label value, HF convention used by the reference
+
+
+def pack_sequences(
+    token_lists: Sequence[Sequence[int]],
+    chunk_size: int,
+    eos_id: int,
+    *,
+    label_lists: Optional[Sequence[Sequence[int]]] = None,
+    pad_id: int = 0,
+) -> dict[str, np.ndarray]:
+    """Greedy-pack variable-length sequences into fixed ``chunk_size`` rows.
+
+    Mirrors the reference ConcatDataset semantics: append ``eos_id`` after each
+    record, start a new chunk when the next record doesn't fit, and **drop**
+    records longer than ``chunk_size`` (reference ``ConcatDataset.py:30-58``).
+    Returns ``input_ids`` ``labels`` ``loss_mask`` arrays ``[n_chunks, chunk_size]``.
+    ``labels`` carry ``IGNORE_INDEX`` over padding; per-record labels may be
+    supplied (SFT prompt masking), defaulting to the input tokens.
+    """
+    chunks_ids: list[np.ndarray] = []
+    chunks_lbl: list[np.ndarray] = []
+
+    cur_ids: list[int] = []
+    cur_lbl: list[int] = []
+
+    def flush() -> None:
+        if not cur_ids:
+            return
+        n = len(cur_ids)
+        ids = np.full(chunk_size, pad_id, dtype=np.int32)
+        lbl = np.full(chunk_size, IGNORE_INDEX, dtype=np.int32)
+        ids[:n] = cur_ids
+        lbl[:n] = cur_lbl
+        chunks_ids.append(ids)
+        chunks_lbl.append(lbl)
+        cur_ids.clear()
+        cur_lbl.clear()
+
+    for i, toks in enumerate(token_lists):
+        toks = list(toks) + [eos_id]
+        lbls = (list(label_lists[i]) + [eos_id]) if label_lists is not None else list(toks)
+        if len(toks) > chunk_size:
+            continue  # overflow record dropped (reference ConcatDataset.py:44-47)
+        if len(cur_ids) + len(toks) > chunk_size:
+            flush()
+        cur_ids.extend(toks)
+        cur_lbl.extend(lbls)
+    flush()
+
+    if not chunks_ids:
+        return {
+            "input_ids": np.zeros((0, chunk_size), np.int32),
+            "labels": np.zeros((0, chunk_size), np.int32),
+            "loss_mask": np.zeros((0, chunk_size), np.float32),
+        }
+    input_ids = np.stack(chunks_ids)
+    labels = np.stack(chunks_lbl)
+    loss_mask = (labels != IGNORE_INDEX).astype(np.float32)
+    return {"input_ids": input_ids, "labels": labels, "loss_mask": loss_mask}
+
+
+def pad_sequences(
+    token_lists: Sequence[Sequence[int]],
+    max_length: int,
+    pad_id: int,
+    *,
+    label_lists: Optional[Sequence[Sequence[int]]] = None,
+    left_pad: bool = False,
+    truncate: bool = True,
+) -> dict[str, np.ndarray]:
+    """Pad (or truncate) every sequence to exactly ``max_length``.
+
+    The reference's PaddedDataset rule: all batches the same length so XLA
+    compiles one graph (``PaddedDataset.py:9-35``).  ``left_pad`` matches the
+    DPO prompt convention (``PaddedDataset.py:60-80``).
+    """
+    n = len(token_lists)
+    input_ids = np.full((n, max_length), pad_id, dtype=np.int32)
+    labels = np.full((n, max_length), IGNORE_INDEX, dtype=np.int32)
+    attn = np.zeros((n, max_length), dtype=np.float32)
+    for i, toks in enumerate(token_lists):
+        toks = list(toks)
+        lbls = list(label_lists[i]) if label_lists is not None else list(toks)
+        if truncate:
+            toks, lbls = toks[:max_length], lbls[:max_length]
+        elif len(toks) > max_length:
+            raise ValueError(f"sequence {i} length {len(toks)} > max_length {max_length}")
+        m = len(toks)
+        if left_pad:
+            input_ids[i, max_length - m :] = toks
+            labels[i, max_length - m :] = lbls
+            attn[i, max_length - m :] = 1.0
+        else:
+            input_ids[i, :m] = toks
+            labels[i, :m] = lbls
+            attn[i, :m] = 1.0
+    loss_mask = (labels != IGNORE_INDEX).astype(np.float32)
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "loss_mask": loss_mask,
+        "attention_mask": attn,
+    }
+
+
+def mask_prompt_labels(
+    prompt_tokens: Sequence[int], response_tokens: Sequence[int]
+) -> tuple[list[int], list[int]]:
+    """SFT tokenization rule: input = prompt+response, labels = IGNORE over the
+    prompt (reference ``model_alignment_data_module.py:148-160``)."""
+    ids = list(prompt_tokens) + list(response_tokens)
+    lbl = [IGNORE_INDEX] * len(prompt_tokens) + list(response_tokens)
+    return ids, lbl

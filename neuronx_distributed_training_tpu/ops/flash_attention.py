@@ -20,7 +20,8 @@ Design notes (see /opt/skills/guides/pallas_guide.md):
   replicates KV via ``kv_shared_group_size`` instead — unnecessary here).
 - backward: two kernels (dq with kv innermost; dkv with q innermost), both
   recomputing p = exp(s - lse) from the saved logsumexp, FlashAttention-2
-  style.  dk/dv are produced per q-head and group-summed outside the kernel.
+  style.  dk/dv are produced per KV-head: the GQA q-head group is a sequential
+  grid dim accumulated in fp32 VMEM scratch.
 
 Layout contract matches ``core_attention``: q [b, sq, nh, d], k/v
 [b, skv, nkv, d], output [b, sq, nh, d].
@@ -138,14 +139,21 @@ def _fwd_kernel(
     @pl.when(ki == num_kv - 1)
     def _finish():
         l = l_scr[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = m_scr[:, :1] + jnp.log(l_safe)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # a row with NO visible key anywhere keeps m ~= NEG_INF: its p values
+        # were exp(s - m) over masked-only scores (garbage, since the finite
+        # NEG_INF cancels) -> force output 0 and lse = NEG_INF.  Rows masked in
+        # one block but visible in another self-correct via alpha rescaling.
+        row_visible = m_scr[:, :1] > NEG_INF / 2
+        o_ref[0, 0] = jnp.where(
+            row_visible, acc_scr[:] / l_safe, 0.0
+        ).astype(o_ref.dtype)
+        lse = jnp.where(row_visible, m_scr[:, :1] + jnp.log(l_safe), NEG_INF)
         lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], SUBLANES))
 
 
 def _fwd_pallas(q, k, v, *, sm_scale, causal, window, q_offset, bq, bkv, interpret):
-    """q [b, nh, sq, d]; k/v [b, nkv, skv, d] -> (o [b, nh, sq, d], lse [b, nh, sq, LANES])."""
+    """q [b, nh, sq, d]; k/v [b, nkv, skv, d] -> (o [b, nh, sq, d], lse [b, nh, sq, SUBLANES])."""
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = nh // nkv
@@ -216,7 +224,9 @@ def _dq_kernel(
         mask = _inner_mask(bq, bkv, qi, ki, causal, window, q_offset)
         if mask is not None:
             s = s + mask
-        p = jnp.exp(s - lse)  # [bq, bkv]
+        # rows with no visible key anywhere carry lse = NEG_INF; exp(s - lse)
+        # would be garbage there, so zero them (matches fwd's 0 output)
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -237,12 +247,13 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, sm_scale, causal, window, q_offset, bq, bkv, num_q,
+    *, sm_scale, causal, window, q_offset, bq, bkv, num_q, group,
 ):
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
+    g = pl.program_id(3)
+    qi = pl.program_id(4)
 
-    @pl.when(qi == 0)
+    @pl.when(jnp.logical_and(g == 0, qi == 0))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -261,7 +272,7 @@ def _dkv_kernel(
         mask = _inner_mask(bq, bkv, qi, ki, causal, window, q_offset)
         if mask is not None:
             s = s + mask
-        p = jnp.exp(s - lse)  # [bq, bkv]
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -277,7 +288,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(jnp.logical_and(g == group - 1, qi == num_q - 1))
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -318,38 +329,37 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
         interpret=interpret,
     )(*in_arrays)
 
-    # dk/dv per q-head, group-summed after the kernel (GQA).
+    # dk/dv per KV-head: the q-head group is a sequential grid dim, accumulated
+    # in the fp32 VMEM scratch — 1x HBM writes and no bf16 intermediate in the
+    # GQA group sum.
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, num_q=num_q, **common),
-        grid=(b, nh, num_kv, num_q),
+        functools.partial(_dkv_kernel, num_q=num_q, group=group, **common),
+        grid=(b, nkv, num_kv, group, num_q),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, nh, skv, d), k.dtype),
-            jax.ShapeDtypeStruct((b, nh, skv, d), v.dtype),
+            jax.ShapeDtypeStruct((b, nkv, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, nkv, skv, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bkv, d), jnp.float32),
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
     )(*in_arrays)
-    if group > 1:
-        dk = dk.reshape(b, nkv, group, skv, d).sum(axis=2)
-        dv = dv.reshape(b, nkv, group, skv, d).sum(axis=2)
     return dq, dk, dv
 
 

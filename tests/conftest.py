@@ -26,3 +26,11 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh(devices8):
+    """Default 8-device mesh: dp=4 x tp=2."""
+    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(tensor_model_parallel_size=2), devices=devices8)

@@ -1,0 +1,151 @@
+"""Data layer: samplers (determinism + resume), packing/padding, DataModules."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_training_tpu.data import (
+    HFDataModule,
+    PretrainingSampler,
+    RandomSampler,
+    SyntheticDataModule,
+    pack_sequences,
+    pad_sequences,
+    process_global_batch,
+)
+from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX, mask_prompt_labels
+from neuronx_distributed_training_tpu.data.sampler import (
+    consumed_samples_from_name,
+    dp_shard,
+)
+
+
+def take(it, n):
+    out = []
+    for _ in range(n):
+        out.append(next(it))
+    return out
+
+
+class TestSamplers:
+    def test_sequential_wraps_and_resumes(self):
+        s = PretrainingSampler(total_samples=10, global_batch_size=4)
+        batches = take(iter(s), 3)
+        assert batches[0].tolist() == [0, 1, 2, 3]
+        assert batches[2].tolist() == [8, 9, 0, 1]  # wraps around
+        assert s.consumed_samples == 12
+        # resume from consumed_samples reproduces the continuation
+        s2 = PretrainingSampler(total_samples=10, global_batch_size=4, consumed_samples=8)
+        assert next(iter(s2)).tolist() == batches[2].tolist()
+
+    def test_random_deterministic_and_resumable(self):
+        a = take(iter(RandomSampler(100, 8, seed=7)), 5)
+        b = take(iter(RandomSampler(100, 8, seed=7)), 5)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        # resume mid-epoch
+        s = RandomSampler(100, 8, seed=7)
+        take(iter(s), 3)
+        resumed = RandomSampler(100, 8, seed=7, consumed_samples=s.consumed_samples)
+        np.testing.assert_array_equal(next(iter(resumed)), a[3])
+
+    def test_random_epoch_reshuffles(self):
+        s = RandomSampler(16, 8, seed=3)
+        batches = take(iter(s), 4)  # 2 epochs
+        epoch0 = np.concatenate(batches[:2])
+        epoch1 = np.concatenate(batches[2:])
+        assert sorted(epoch0.tolist()) == list(range(16))
+        assert sorted(epoch1.tolist()) == list(range(16))
+        assert epoch0.tolist() != epoch1.tolist()
+
+    def test_dp_shard(self):
+        batch = np.arange(8)
+        assert dp_shard(batch, 0, 4).tolist() == [0, 1]
+        assert dp_shard(batch, 3, 4).tolist() == [6, 7]
+        with pytest.raises(ValueError):
+            dp_shard(np.arange(6), 0, 4)
+
+    def test_consumed_samples_from_name(self):
+        assert consumed_samples_from_name("x-step=10-consumed_samples=128000.0.ckpt") == 128000
+        assert consumed_samples_from_name("step_5_consumed_samples=64") == 64
+        assert consumed_samples_from_name("nothing") is None
+
+
+class TestPacking:
+    def test_pack_basic(self):
+        out = pack_sequences([[1, 2, 3], [4, 5], [6, 7, 8, 9]], chunk_size=8, eos_id=99)
+        # [1,2,3,99,4,5,99,pad] then [6,7,8,9,99,...]
+        assert out["input_ids"].shape == (2, 8)
+        assert out["input_ids"][0].tolist() == [1, 2, 3, 99, 4, 5, 99, 0]
+        assert out["loss_mask"][0].tolist() == [1, 1, 1, 1, 1, 1, 1, 0]
+        assert out["input_ids"][1, :5].tolist() == [6, 7, 8, 9, 99]
+
+    def test_pack_drops_overflow(self):
+        out = pack_sequences([[1] * 20, [2, 3]], chunk_size=8, eos_id=9)
+        assert out["input_ids"].shape[0] == 1
+        assert out["input_ids"][0, :3].tolist() == [2, 3, 9]
+
+    def test_pack_with_labels(self):
+        ids, lbl = mask_prompt_labels([1, 2], [3, 4])
+        out = pack_sequences([ids], chunk_size=8, eos_id=9, label_lists=[lbl])
+        assert out["labels"][0, :5].tolist() == [IGNORE_INDEX, IGNORE_INDEX, 3, 4, 9]
+        assert out["loss_mask"][0, :5].tolist() == [0, 0, 1, 1, 1]
+
+    def test_pad_right_and_left(self):
+        r = pad_sequences([[1, 2, 3]], max_length=5, pad_id=0)
+        assert r["input_ids"][0].tolist() == [1, 2, 3, 0, 0]
+        assert r["attention_mask"][0].tolist() == [1, 1, 1, 0, 0]
+        l = pad_sequences([[1, 2, 3]], max_length=5, pad_id=0, left_pad=True)
+        assert l["input_ids"][0].tolist() == [0, 0, 1, 2, 3]
+        assert l["loss_mask"][0].tolist() == [0, 0, 1, 1, 1]
+
+    def test_pad_truncates(self):
+        r = pad_sequences([[1, 2, 3, 4, 5, 6]], max_length=4, pad_id=0)
+        assert r["input_ids"][0].tolist() == [1, 2, 3, 4]
+
+
+class TestDataModules:
+    def test_process_global_batch_derives_labels_and_mask(self):
+        ids = np.array([[1, 2, 0, 0]], dtype=np.int32)
+        out = process_global_batch({"input_ids": ids}, pad_id=0)
+        np.testing.assert_array_equal(out["labels"], ids)
+        assert out["loss_mask"][0].tolist() == [1, 1, 0, 0]
+
+    def test_synthetic_deterministic(self):
+        dm1 = SyntheticDataModule(vocab_size=100, seq_len=16, global_batch_size=4, seed=5)
+        dm2 = SyntheticDataModule(vocab_size=100, seq_len=16, global_batch_size=4, seed=5)
+        b1 = take(dm1.global_batches(), 2)
+        b2 = take(dm2.global_batches(), 2)
+        for x, y in zip(b1, b2):
+            np.testing.assert_array_equal(x["input_ids"], y["input_ids"])
+        assert b1[0]["input_ids"].shape == (4, 16)
+        assert dm1.consumed_samples == 8
+
+    def test_synthetic_resume_exactness(self):
+        dm = SyntheticDataModule(vocab_size=50, seq_len=8, global_batch_size=2)
+        take(dm.global_batches(), 3)
+        resumed = SyntheticDataModule(
+            vocab_size=50, seq_len=8, global_batch_size=2,
+            consumed_samples=dm.consumed_samples,
+        )
+        a = next(dm.global_batches())
+        b = next(resumed.global_batches())
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+    def test_hf_datamodule_from_dict_dataset(self):
+        import datasets
+
+        ds = datasets.Dataset.from_dict(
+            {"input_ids": np.arange(32).reshape(8, 4).tolist()}
+        )
+        dm = HFDataModule(ds, global_batch_size=4)
+        b = next(dm.global_batches())
+        assert b["input_ids"].shape == (4, 4)
+        assert b["input_ids"][0].tolist() == [0, 1, 2, 3]
+
+    def test_sharded_batches(self, cpu_mesh):
+        dm = SyntheticDataModule(vocab_size=10, seq_len=8, global_batch_size=8)
+        b = next(dm.sharded_batches(cpu_mesh))
+        assert b["input_ids"].shape == (8, 8)
+        import jax
+
+        assert isinstance(b["input_ids"], jax.Array)
