@@ -1,0 +1,15 @@
+"""Checkpointing — sharded async save/load, top-k retention, auto-resume.
+
+TPU-native re-design of the reference's checkpoint stack
+(``NLPCheckpointIO`` → ``nxd.save_checkpoint/load_checkpoint``, reference
+``nlp_overrides.py:535-639``; resume discovery in ``exp_manager.py:333-404``),
+built on Orbax/TensorStore: every host writes its own shards (the xser
+tensor-streaming role), async save runs in a background thread (the
+``async_checkpointing`` role), retention keeps top-k + last.
+"""
+
+from neuronx_distributed_training_tpu.checkpoint.manager import (  # noqa: F401
+    CheckpointConfig,
+    Checkpointer,
+    TrainState,
+)

@@ -1,0 +1,224 @@
+"""Orbax-backed checkpoint manager.
+
+Capability map to the reference (SURVEY.md §5.4):
+
+- per-rank sharded save / tensor streaming (``save_xser``/``load_xser``,
+  reference ``nlp_overrides.py:1141-1155``)      -> Orbax OCDBT/TensorStore,
+  every process writes its own shards, restore is sharding-aware;
+- ``async_checkpointing`` (forked writer process, ``known_issues.rst:53-81``)
+  -> Orbax async checkpointing (background thread + commit future);
+- top-k retention + auto-delete (``config_overview.rst:243-249``)
+  -> ``max_to_keep`` + ``best_fn`` on the monitored metric;
+- auto-resume from newest checkpoint (``exp_manager.py:333-404``)
+  -> ``latest_step()`` + ``restore``;
+- filename-encoded ``consumed_samples`` (``data/base.py:40-47``)
+  -> explicit ``meta`` JSON item per step (no regex parsing needed; the value
+  rides inside the checkpoint);
+- ``weight_init_only`` warm start (``nlp_overrides.py:541-568``)
+  -> ``restore_params_only``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Mirrors the reference's ``exp_manager.checkpoint_callback_params`` +
+    ``save_xser``/``async_checkpointing`` knobs (``config_overview.rst:243-308``)."""
+
+    dir: str | Path = "checkpoints"
+    save_top_k: int = 3
+    every_n_train_steps: int = 100
+    async_save: bool = True
+    monitor: str = "loss"  # metric whose *lowest* value defines "best"
+
+    @classmethod
+    def from_config(cls, cfg: dict[str, Any]) -> "CheckpointConfig":
+        em = dict(cfg.get("exp_manager", {}) or {})
+        cb = dict(em.get("checkpoint_callback_params", {}) or {})
+        return cls(
+            dir=em.get("explicit_log_dir") or em.get("exp_dir") or "checkpoints",
+            save_top_k=int(cb.get("save_top_k", 3)),
+            every_n_train_steps=int(cb.get("every_n_train_steps", 100)),
+            async_save=bool(cb.get("async_checkpointing", em.get("async_checkpointing", True))),
+            monitor=str(cb.get("monitor", "loss")),
+        )
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Everything a resume needs (the reference spreads this across the PTL
+    checkpoint dict, loop progress, and the ckpt filename)."""
+
+    params: Any
+    opt_state: Any
+    step: int
+    consumed_samples: int
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _abstract_like(tree: Any, specs: Any, mesh: Optional[Mesh]) -> Any:
+    """ShapeDtypeStruct pytree (with shardings when a mesh is given) for
+    sharding-aware restore."""
+
+    def one(x, s):
+        sharding = NamedSharding(mesh, s) if mesh is not None else None
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree_util.tree_map(
+        one, tree, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def _abstract_from_tree(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+        tree,
+    )
+
+
+class Checkpointer:
+    """Save/restore ``TrainState`` with retention + async + auto-resume."""
+
+    def __init__(self, config: CheckpointConfig, *, keep_last: bool = True):
+        self.config = config
+        directory = Path(config.dir).absolute()
+        preservation = None
+        if config.save_top_k > 0:
+            from orbax.checkpoint.checkpoint_managers import preservation_policy as pp
+
+            def metric_fn(metrics: Any) -> float:
+                return float((metrics or {}).get(self.config.monitor, float("inf")))
+
+            policies = [
+                # reverse=True keeps the *lowest* metric values (loss-like)
+                pp.BestN(get_metric_fn=metric_fn, n=config.save_top_k, reverse=True),
+            ]
+            if keep_last:
+                # "last" must survive top-k eviction for auto-resume correctness
+                # (the reference keeps top-k AND last, exp_manager.py:517-579)
+                policies.append(pp.LatestN(n=1))
+            preservation = pp.AnyPreservationPolicy(policies)
+
+        options = ocp.CheckpointManagerOptions(
+            preservation_policy=preservation,
+            enable_async_checkpointing=config.async_save,
+            save_interval_steps=1,  # step gating is the trainer's job
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    @property
+    def directory(self) -> Path:
+        return Path(self._mgr.directory)
+
+    # -- save ---------------------------------------------------------------
+
+    def save(
+        self,
+        state: TrainState,
+        *,
+        metrics: Optional[dict[str, float]] = None,
+        force: bool = False,
+    ) -> bool:
+        meta = {
+            "step": int(state.step),
+            "consumed_samples": int(state.consumed_samples),
+            **{k: v for k, v in state.extra.items()},
+        }
+        return self._mgr.save(
+            int(state.step),
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(state.params),
+                opt_state=ocp.args.StandardSave(state.opt_state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+            metrics={k: float(v) for k, v in (metrics or {}).items()},
+            force=force,
+        )
+
+    def wait(self) -> None:
+        """Block until any in-flight async save commits."""
+        self._mgr.wait_until_finished()
+
+    # -- restore ------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self,
+        params_template: Any,
+        opt_template: Any,
+        *,
+        step: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        param_specs: Any = None,
+        opt_specs: Any = None,
+    ) -> TrainState:
+        """Restore the newest (or given) step.  Templates are live pytrees or
+        ShapeDtypeStructs; pass mesh+specs to restore direct-to-sharded."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        if mesh is not None and param_specs is not None:
+            p_abs = _abstract_like(params_template, param_specs, mesh)
+            o_abs = _abstract_like(opt_template, opt_specs, mesh)
+        else:
+            p_abs = _abstract_from_tree(params_template)
+            o_abs = _abstract_from_tree(opt_template)
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(p_abs),
+                opt_state=ocp.args.StandardRestore(o_abs),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        meta = dict(restored["meta"])
+        return TrainState(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            step=int(meta.pop("step")),
+            consumed_samples=int(meta.pop("consumed_samples")),
+            extra=meta,
+        )
+
+    def restore_params_only(
+        self,
+        params_template: Any,
+        *,
+        step: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        param_specs: Any = None,
+    ) -> Any:
+        """The reference's ``weight_init_only`` warm start
+        (``nlp_overrides.py:565-568``): weights without optimizer/loop state."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        if mesh is not None and param_specs is not None:
+            p_abs = _abstract_like(params_template, param_specs, mesh)
+        else:
+            p_abs = _abstract_from_tree(params_template)
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(params=ocp.args.StandardRestore(p_abs))
+        )
+        return restored["params"]
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.wait()
+        self.close()

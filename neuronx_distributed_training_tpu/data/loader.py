@@ -64,17 +64,22 @@ def process_global_batch(
 def shard_batch(
     batch: dict[str, np.ndarray], mesh: Mesh, spec: Optional[P] = None
 ) -> dict[str, jax.Array]:
-    """Host numpy global batch -> sharded device arrays.
+    """Host numpy **global** batch -> sharded device arrays.
 
-    Each process passes its **process-local** rows; under one process this is
-    the whole batch.  Replaces the reference's MpDeviceLoader host->device move
-    (``base.py:330-350``).
+    Every process holds the full global batch (samplers are deterministic, so
+    all hosts compute identical batches — reference keeps the global batch on
+    CPU the same way, ``data/base.py:58-64``); each process device_puts only the
+    slices its addressable devices own, so multi-host needs no communication.
+    Replaces the reference's MpDeviceLoader host->device move (``base.py:330-350``).
     """
     spec = spec if spec is not None else P(DATA_AXES)
     sharding = NamedSharding(mesh, spec)
-    return {
-        k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
-    }
+    out: dict[str, jax.Array] = {}
+    for k, v in batch.items():
+        idx_map = sharding.addressable_devices_indices_map(v.shape)
+        shards = [jax.device_put(v[idx], d) for d, idx in idx_map.items()]
+        out[k] = jax.make_array_from_single_device_arrays(v.shape, sharding, shards)
+    return out
 
 
 class DataModule:

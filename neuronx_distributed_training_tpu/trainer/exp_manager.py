@@ -1,0 +1,126 @@
+"""Experiment management: log dirs, TensorBoard, throughput, resume detection.
+
+Re-design of the reference's ``utils/exp_manager.py`` (579 LoC of NeMo
+exp-manager glue): log-dir/version management (``exp_manager.py:81-200``),
+TensorBoard logger creation (``:271-291``), step timing (``TimingCallback``,
+``:64-78``), and auto-resume discovery (``check_resume``, ``:333-404``) —
+without Lightning callbacks: the trainer calls ``log_metrics`` directly and
+Orbax ``latest_step`` replaces newest-``*.ckpt`` scanning.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from neuronx_distributed_training_tpu.utils.perf import Throughput
+
+logger = logging.getLogger(__name__)
+
+
+class ExpManager:
+    """Owns the experiment directory and metric writers."""
+
+    def __init__(
+        self,
+        exp_dir: str | Path = "nxdt_experiments",
+        name: str = "default",
+        *,
+        version: Optional[str] = None,
+        create_tensorboard_logger: bool = True,
+        log_every_n_steps: int = 10,
+        global_batch_size: int = 1,
+        resume_if_exists: bool = False,
+    ):
+        base = Path(exp_dir) / name
+        if version is None:
+            if resume_if_exists and base.exists():
+                versions = sorted(
+                    int(p.name.split("_")[1])
+                    for p in base.glob("version_*")
+                    if p.name.split("_")[1].isdigit()
+                )
+                version = f"version_{versions[-1]}" if versions else "version_0"
+            else:
+                n = 0
+                while (base / f"version_{n}").exists():
+                    n += 1
+                version = f"version_{n}"
+        self.log_dir = base / version
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.checkpoint_dir = self.log_dir / "checkpoints"
+        self.log_every_n_steps = log_every_n_steps
+        self.throughput = Throughput(global_batch_size)
+        self._last_tput: Optional[float] = None
+        self._last_step_time: Optional[float] = None
+        self._metrics_file = self.log_dir / "metrics.jsonl"
+
+        self._tb = None
+        if create_tensorboard_logger:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=str(self.log_dir / "tb"))
+            except Exception as e:  # noqa: BLE001 — TB is optional observability
+                logger.warning("TensorBoard logger unavailable: %s", e)
+
+    @classmethod
+    def from_config(cls, cfg: dict[str, Any], global_batch_size: int = 1) -> "ExpManager":
+        """Build from the reference's ``exp_manager:`` block
+        (``config_overview.rst:200-249``)."""
+        em = dict(cfg.get("exp_manager", {}) or {})
+        return cls(
+            exp_dir=em.get("explicit_log_dir") or em.get("exp_dir") or "nxdt_experiments",
+            name=em.get("name", cfg.get("name", "default")),
+            create_tensorboard_logger=bool(em.get("create_tensorboard_logger", True)),
+            log_every_n_steps=int(
+                (cfg.get("trainer", {}) or {}).get("log_every_n_steps", 10)
+            ),
+            global_batch_size=global_batch_size,
+            resume_if_exists=bool(em.get("resume_if_exists", False)),
+        )
+
+    # -- per-step hooks -----------------------------------------------------
+
+    def step_timed(self) -> float:
+        """Record a step boundary; returns step wall seconds (0.0 on first)."""
+        now = time.perf_counter()
+        dt = 0.0 if self._last_step_time is None else now - self._last_step_time
+        self._last_step_time = now
+        if dt > 0:
+            self._last_tput = self.throughput.update(dt)
+        return dt
+
+    def log_metrics(self, step: int, metrics: dict[str, Any], *, force: bool = False) -> None:
+        """Write scalars (TB + jsonl) every ``log_every_n_steps``.
+
+        Scalars logged mirror the reference's set: reduced_train_loss, lr,
+        grad/param norm, throughput, throughput_peak, consumed_samples
+        (``base.py:624-654``)."""
+        if not force and step % self.log_every_n_steps != 0:
+            return
+        flat = {k: float(v) for k, v in metrics.items() if _is_scalar(v)}
+        if self._last_tput is not None:
+            flat["throughput_seqs_per_sec"] = self._last_tput
+            flat["throughput_peak"] = self.throughput.peak
+        if self._tb is not None:
+            for k, v in flat.items():
+                self._tb.add_scalar(k, v, step)
+        with open(self._metrics_file, "a") as f:
+            f.write(json.dumps({"step": step, **flat}) + "\n")
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()
+
+
+def _is_scalar(v: Any) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False

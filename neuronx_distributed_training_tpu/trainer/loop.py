@@ -1,0 +1,263 @@
+"""The training loop — `train(cfg)` replaces the reference's L1/L2 stack.
+
+Where the reference assembles NLPTrainer + NLPDDPStrategy + Lightning fit loops
++ exp_manager (reference ``examples/training.py:41-94``,
+``nlp_overrides.py:288-533``), this is one explicit loop:
+
+    cfg -> mesh, dtype policy, model, data module, optimizer, checkpointer
+    for step in range(max_steps):
+        batch -> sharded device arrays -> jitted train step -> metrics
+        periodic: validation, checkpoint (async), logging
+
+Auto-resume restores params/opt-state/step/consumed-samples from the newest
+checkpoint (the reference's ``resume_if_exists`` flow, ``exp_manager.py:333-404``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from neuronx_distributed_training_tpu.checkpoint import (
+    CheckpointConfig,
+    Checkpointer,
+    TrainState,
+)
+from neuronx_distributed_training_tpu.config.loader import ConfigDict, batch_schedule
+from neuronx_distributed_training_tpu.data import DataModule, SyntheticDataModule
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.optim.adamw import (
+    AdamWConfig,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.optim.lr import build_lr_schedule
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+from neuronx_distributed_training_tpu.trainer.exp_manager import ExpManager
+from neuronx_distributed_training_tpu.trainer.step import (
+    jit_train_step,
+    make_eval_step,
+    make_train_step,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Trainer:
+    """Assembled training session.  Build with ``Trainer.from_config``."""
+
+    cfg: ConfigDict
+    mesh: Any
+    policy: DtypePolicy
+    model_cfg: Any
+    loss_fn: Callable
+    params: Any
+    opt_state: Any
+    param_specs: Any
+    opt_specs: Any
+    train_step: Callable
+    eval_step: Optional[Callable]
+    data_module: DataModule
+    val_data_module: Optional[DataModule]
+    exp: ExpManager
+    checkpointer: Optional[Checkpointer]
+    max_steps: int
+    step: int = 0
+
+    # -- assembly -----------------------------------------------------------
+
+    @classmethod
+    def from_config(
+        cls,
+        cfg: ConfigDict,
+        *,
+        data_module: Optional[DataModule] = None,
+        val_data_module: Optional[DataModule] = None,
+        devices: Optional[list] = None,
+        enable_checkpointing: bool = True,
+    ) -> "Trainer":
+        devices = devices if devices is not None else jax.devices()
+        mesh_cfg = MeshConfig.from_config(cfg.get("distributed_strategy", {}))
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        policy = DtypePolicy.from_precision_config(cfg.get("precision", {}))
+        sched = batch_schedule(cfg, len(devices))
+
+        model_cfg, loss_fn, init_fn, specs_fn = build_model(cfg, policy)
+        seed = int(cfg.get("seed", 1234))
+        params = init_fn(jax.random.PRNGKey(seed))
+        pspecs = specs_fn()
+        opt_block = dict((cfg.get("model", {}) or {}).get("optim", {}) or {})
+        opt_cfg = AdamWConfig.from_config(opt_block, cfg.get("trainer", {}))
+        zero1 = bool(cfg.get("distributed_strategy", {}).get("zero1", True))
+        opt_state = init_opt_state(params, policy)
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=zero1, policy=policy)
+
+        max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
+        lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
+        step_fn = make_train_step(
+            loss_fn, opt_cfg, lr_schedule, policy,
+            num_microbatches=sched["num_microbatches"],
+        )
+        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs)
+        eval_fn = jax.jit(make_eval_step(loss_fn)) if val_data_module else None
+
+        # shard initial state onto the mesh
+        import functools
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ns = functools.partial(NamedSharding, mesh)
+        put = lambda tree, specs: jax.device_put(
+            tree, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        params = put(params, pspecs)
+        opt_state = put(opt_state, ospecs)
+
+        if data_module is None:
+            seq = int((cfg.get("data", {}) or {}).get("seq_length", 2048))
+            data_module = SyntheticDataModule(
+                vocab_size=model_cfg.vocab_size,
+                seq_len=seq,
+                global_batch_size=sched["global_batch_size"],
+                seed=seed,
+            )
+
+        exp = ExpManager.from_config(cfg, global_batch_size=sched["global_batch_size"])
+        checkpointer = None
+        if enable_checkpointing:
+            ck_cfg = CheckpointConfig.from_config(cfg)
+            ck_cfg = dataclasses.replace(ck_cfg, dir=exp.checkpoint_dir)
+            checkpointer = Checkpointer(ck_cfg)
+
+        return cls(
+            cfg=cfg, mesh=mesh, policy=policy, model_cfg=model_cfg, loss_fn=loss_fn,
+            params=params, opt_state=opt_state, param_specs=pspecs, opt_specs=ospecs,
+            train_step=jstep, eval_step=eval_fn, data_module=data_module,
+            val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
+            max_steps=max_steps,
+        )
+
+    # -- resume -------------------------------------------------------------
+
+    def maybe_resume(self) -> bool:
+        """Restore newest checkpoint if one exists (reference ``resume_if_exists``)."""
+        if self.checkpointer is None or self.checkpointer.latest_step() is None:
+            return False
+        state = self.checkpointer.restore(
+            self.params, self.opt_state,
+            mesh=self.mesh, param_specs=self.param_specs, opt_specs=self.opt_specs,
+        )
+        self.params = state.params
+        self.opt_state = state.opt_state
+        self.step = state.step
+        self.data_module.sampler.consumed_samples = state.consumed_samples
+        logger.info(
+            "resumed from step %d (consumed_samples=%d)", state.step, state.consumed_samples
+        )
+        return True
+
+    # -- the loop -----------------------------------------------------------
+
+    def fit(self) -> dict[str, float]:
+        cfg_t = dict(self.cfg.get("trainer", {}) or {})
+        val_interval = int(cfg_t.get("val_check_interval", 0) or 0)
+        limit_val = int(cfg_t.get("limit_val_batches", 10) or 10)
+        ck_every = (
+            self.checkpointer.config.every_n_train_steps if self.checkpointer else 0
+        )
+
+        self.maybe_resume()
+        last_metrics: dict[str, float] = {}
+        batches = self.data_module.sharded_batches(self.mesh)
+        try:
+            with self.mesh, shd.use_mesh(self.mesh):
+                self.exp.step_timed()  # arm the step timer
+                while self.step < self.max_steps:
+                    batch = next(batches)
+                    key = jax.random.fold_in(jax.random.PRNGKey(0), self.step)
+                    self.params, self.opt_state, metrics = self.train_step(
+                        self.params, self.opt_state, batch, key
+                    )
+                    self.step += 1
+                    # host sync happens here (metric fetch), once per step
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    dt = self.exp.step_timed()
+                    last_metrics["step_time"] = dt
+                    last_metrics["consumed_samples"] = self.data_module.consumed_samples
+                    self.exp.log_metrics(self.step, last_metrics)
+
+                    if val_interval and self.step % val_interval == 0 and self.eval_step:
+                        last_metrics["val_loss"] = self.validate(limit_val)
+                        self.exp.log_metrics(
+                            self.step, {"val_loss": last_metrics["val_loss"]}, force=True
+                        )
+                    if ck_every and self.step % ck_every == 0:
+                        self.save_checkpoint(last_metrics)
+                if ck_every and self.checkpointer is not None:
+                    self.save_checkpoint(last_metrics)  # final save
+        finally:
+            if self.checkpointer is not None:
+                self.checkpointer.wait()
+                self.checkpointer.close()
+            self.exp.close()
+        return last_metrics
+
+    def validate(self, limit_batches: int) -> float:
+        losses = []
+        it = self.val_data_module.sharded_batches(self.mesh)
+        for i, batch in enumerate(it):
+            if i >= limit_batches:
+                break
+            m = self.eval_step(self.params, batch, jax.random.PRNGKey(0))
+            losses.append(float(m["val_loss"]))
+        return float(np.mean(losses)) if losses else float("nan")
+
+    def save_checkpoint(self, metrics: Optional[dict[str, float]] = None) -> None:
+        if self.checkpointer is None:
+            return
+        self.checkpointer.save(
+            TrainState(
+                params=self.params,
+                opt_state=self.opt_state,
+                step=self.step,
+                consumed_samples=self.data_module.consumed_samples,
+            ),
+            metrics=metrics,
+        )
+
+
+def build_model(cfg: ConfigDict, policy: DtypePolicy):
+    """Model dispatch by ``model_source`` (reference ``training.py:71-91``).
+
+    Returns ``(model_cfg, loss_fn, init_fn, specs_fn)``.
+    """
+    source = str(cfg.get("model_source", "hf")).lower()
+    model_block = dict(cfg.get("model", {}) or {})
+    ds_block = dict(cfg.get("distributed_strategy", {}) or {})
+    arch = str(model_block.get("architecture", model_block.get("model_type", "llama"))).lower()
+
+    if source in ("hf", "megatron") and arch in ("llama", "mistral"):
+        mc = llama.LlamaConfig.from_config(model_block, ds_block)
+
+        def loss_fn(p, batch, key):
+            return llama.forward(p, batch, mc, policy)
+
+        return (
+            mc,
+            loss_fn,
+            lambda key: llama.init_params(key, mc, policy),
+            lambda: llama.param_specs(mc),
+        )
+    raise ValueError(f"unsupported model_source/architecture: {source}/{arch}")
+
+
+def train(cfg: ConfigDict, **kw: Any) -> dict[str, float]:
+    """The ``train(cfg)`` entry point (reference ``examples/training.py:41``)."""
+    trainer = Trainer.from_config(cfg, **kw)
+    return trainer.fit()
