@@ -38,7 +38,7 @@ from neuronx_distributed_training_tpu.parallel import sharding as shd
 NEG_INF = -1e30
 
 
-def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, *, scale, q_off, kv_off, causal):
+def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale, causal):
     """One online-softmax accumulation step against KV chunk (kc, vc).
 
     q [b, h, sq, d]; kc/vc [b, h, skv, d]; o_acc [b, h, sq, d];
@@ -99,7 +99,7 @@ def _ring_local(q, k, v, *, axis_name, cp, causal):
         o_acc, m_acc, l_acc, kc, vc = carry
         src = jax.lax.rem(my - t + cp, cp)  # rank whose chunk we currently hold
         o_acc, m_acc, l_acc = compute(
-            qh, kc, vc, o_acc, m_acc, l_acc, q_off=q_off, kv_off=src * skv
+            qh, kc, vc, o_acc, m_acc, l_acc, q_off, src * skv
         )
         # rotate KV around the ring (skipped result unused on last step, but
         # keeping it unconditional keeps the collective schedule uniform)
@@ -140,14 +140,17 @@ def ring_attention(
 
     h, kvh = q.shape[2], k.shape[2]
     tp = int(mesh.shape.get("model", 1))
-    # shard_map needs exact divisibility of the head dim; KV heads smaller than
-    # tp would need replication (the reference's kv_shared_group_size trick) —
-    # shard KV heads over model only when they divide.
-    kv_head_axis = "model" if (tp > 1 and kvh % tp == 0) else None
-    if tp > 1 and h % tp != 0:
-        raise ValueError(f"attention heads {h} not divisible by tp {tp}")
+    if tp > 1 and (h % tp != 0 or kvh % tp != 0):
+        # Per-rank GQA head mapping inside shard_map requires both head counts
+        # to divide tp (replicated KV with sharded Q would misalign the q->kv
+        # group mapping rank-locally).  Fall back to GSPMD core attention —
+        # correct, just without the ring (the reference's kv_shared_group_size
+        # replication trick is a later optimization).
+        from neuronx_distributed_training_tpu.ops.attention import core_attention
+
+        return core_attention(q, k, v, causal=causal)
     q_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
-    kv_spec = P(DATA_AXES, "context", kv_head_axis, None)
+    kv_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
 
     body = functools.partial(
         _ring_local, axis_name=axis_name, cp=cp, causal=causal
