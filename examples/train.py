@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Training launcher — thin wrapper over the packaged CLI.
+
+    python examples/train.py --config examples/conf/hf_llama3_8B_config.yaml
+
+See ``neuronx_distributed_training_tpu/trainer/cli.py`` (== ``nxdt-train``) for
+the full surface: dotted ``--set`` overrides, ``--compile-only`` AOT warmup,
+``TRAIN_ITERS`` test hook.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from neuronx_distributed_training_tpu.trainer.cli import main
+
+if __name__ == "__main__":
+    main()

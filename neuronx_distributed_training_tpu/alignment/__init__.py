@@ -1,0 +1,10 @@
+"""Model alignment — SFT / DPO / ORPO recipes."""
+
+from neuronx_distributed_training_tpu.alignment.losses import (  # noqa: F401
+    dpo_loss,
+    orpo_loss,
+    sequence_logprobs,
+)
+from neuronx_distributed_training_tpu.alignment.dpo import (  # noqa: F401
+    compute_reference_logprobs,
+)

@@ -1,0 +1,83 @@
+"""DPO pre-fit reference pass + loss adapter.
+
+The reference's DPO flow (``base_dpo.py:23-66``): before training, run the
+frozen policy over the whole train set, compute chosen/rejected reference
+log-probs, append them as dataset columns, and rebuild the dataloader
+mid-fit (``fit_loop.setup_data(updated_data_source=...)``).  TPU-native: the
+pre-fit pass is a jitted eval function mapped over the dataset once; the
+"column append" is a plain numpy array carried next to the batches (no
+dataloader surgery needed — batches are dicts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import numpy as np
+
+from neuronx_distributed_training_tpu.alignment.losses import dpo_loss, sequence_logprobs
+
+# ForwardLogits: (params, batch) -> logits [b, s, vocab]
+ForwardLogits = Callable[[Any, dict], jax.Array]
+
+
+def compute_reference_logprobs(
+    params: Any,
+    batches: Iterable[dict[str, np.ndarray]],
+    forward_logits: ForwardLogits,
+) -> dict[str, np.ndarray]:
+    """Frozen-policy chosen/rejected log-probs over the train set.
+
+    ``batches`` yield DPO-shaped dicts with ``chosen_input_ids``,
+    ``chosen_loss_mask``, ``rejected_input_ids``, ``rejected_loss_mask``
+    (the PaddedDPODataset key layout, reference ``PaddedDataset.py:60-103``).
+    Returns the two reference-logp columns, concatenated in dataset order.
+    """
+
+    @jax.jit
+    def one(params, batch):
+        out = {}
+        for side in ("chosen", "rejected"):
+            logits = forward_logits(params, {"input_ids": batch[f"{side}_input_ids"]})
+            out[side] = sequence_logprobs(
+                logits, batch[f"{side}_input_ids"], batch.get(f"{side}_loss_mask")
+            )
+        return out
+
+    chosen, rejected = [], []
+    for batch in batches:
+        res = one(params, batch)
+        chosen.append(np.asarray(res["chosen"]))
+        rejected.append(np.asarray(res["rejected"]))
+    return {
+        "reference_chosen_logps": np.concatenate(chosen),
+        "reference_rejected_logps": np.concatenate(rejected),
+    }
+
+
+def make_dpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):
+    """Build a trainer-compatible loss_fn for DPO batches.
+
+    Batch contract: ``chosen_input_ids``/``rejected_input_ids`` (+ loss masks)
+    plus the precomputed ``reference_chosen_logps``/``reference_rejected_logps``
+    columns from ``compute_reference_logprobs``.
+    """
+
+    def loss_fn(params, batch, _key):
+        pc = sequence_logprobs(
+            forward_logits(params, {"input_ids": batch["chosen_input_ids"]}),
+            batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
+        )
+        pr = sequence_logprobs(
+            forward_logits(params, {"input_ids": batch["rejected_input_ids"]}),
+            batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
+        )
+        loss, metrics = dpo_loss(
+            pc, pr,
+            batch["reference_chosen_logps"], batch["reference_rejected_logps"],
+            beta=beta,
+        )
+        return loss, metrics
+
+    return loss_fn

@@ -1,0 +1,96 @@
+"""DPO / ORPO losses.
+
+Re-derivations of the reference's alignment losses:
+- DPO: ``-logsigmoid(beta * (pi_logratios - ref_logratios))`` plus
+  chosen/rejected reward metrics (reference ``base_dpo.py:90-109``);
+- ORPO: NLL on the chosen response (length-averaged logps) + the odds-ratio
+  term, no reference model (reference ``base_orpo.py:26-46``).
+
+Both consume per-sequence log-probs from ``sequence_logprobs`` — the
+vocab-parallel ``from_parallel_logits_to_logprobs`` analogue
+(``ops.cross_entropy.logprobs_from_logits`` partitions over sharded vocab).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.ops.cross_entropy import logprobs_from_logits
+
+
+def sequence_logprobs(
+    logits: jax.Array,  # [b, s, vocab] (vocab may be TP-sharded)
+    labels: jax.Array,  # [b, s]
+    loss_mask: Optional[jax.Array] = None,  # [b, s]; 1 on response tokens
+    *,
+    shift: bool = True,
+    average: bool = False,
+) -> jax.Array:
+    """Per-sequence sum (or mean) log p(label) over response tokens -> [b]."""
+    if shift:
+        logits = logits[:, :-1]
+        labels = labels[:, 1:]
+        loss_mask = None if loss_mask is None else loss_mask[:, 1:]
+    per_tok = logprobs_from_logits(logits, jnp.maximum(labels, 0))
+    mask = (labels >= 0).astype(jnp.float32)
+    if loss_mask is not None:
+        mask = mask * loss_mask.astype(jnp.float32)
+    total = jnp.sum(per_tok * mask, axis=-1)
+    if average:
+        return total / jnp.maximum(jnp.sum(mask, axis=-1), 1.0)
+    return total
+
+
+def dpo_loss(
+    policy_chosen_logps: jax.Array,  # [b]
+    policy_rejected_logps: jax.Array,
+    reference_chosen_logps: jax.Array,
+    reference_rejected_logps: jax.Array,
+    *,
+    beta: float = 0.1,
+    label_smoothing: float = 0.0,
+):
+    """DPO sigmoid loss + reward metrics (reference ``base_dpo.py:90-109``)."""
+    pi_logratios = policy_chosen_logps - policy_rejected_logps
+    ref_logratios = reference_chosen_logps - reference_rejected_logps
+    logits = pi_logratios - ref_logratios
+    loss = (
+        -jax.nn.log_sigmoid(beta * logits) * (1 - label_smoothing)
+        - jax.nn.log_sigmoid(-beta * logits) * label_smoothing
+    )
+    chosen_rewards = beta * (policy_chosen_logps - reference_chosen_logps)
+    rejected_rewards = beta * (policy_rejected_logps - reference_rejected_logps)
+    metrics = {
+        "rewards_chosen": jnp.mean(chosen_rewards),
+        "rewards_rejected": jnp.mean(rejected_rewards),
+        "reward_accuracy": jnp.mean((chosen_rewards > rejected_rewards).astype(jnp.float32)),
+        "reward_margin": jnp.mean(chosen_rewards - rejected_rewards),
+    }
+    return jnp.mean(loss), metrics
+
+
+def orpo_loss(
+    chosen_avg_logps: jax.Array,  # [b] length-AVERAGED log p (reference base_orpo.py)
+    rejected_avg_logps: jax.Array,
+    chosen_nll: jax.Array,  # scalar NLL over chosen responses
+    *,
+    beta: float = 0.1,
+):
+    """ORPO: NLL(chosen) + beta * odds-ratio term (reference ``base_orpo.py:26-46``)."""
+    # log odds ratio: log( odds(chosen) / odds(rejected) ),
+    # odds(p) = p / (1 - p) computed in log space for stability
+    log_odds = (chosen_avg_logps - rejected_avg_logps) - (
+        jnp.log1p(-jnp.exp(jnp.clip(chosen_avg_logps, a_max=-1e-6)))
+        - jnp.log1p(-jnp.exp(jnp.clip(rejected_avg_logps, a_max=-1e-6)))
+    )
+    ratio_term = -jax.nn.log_sigmoid(log_odds)
+    loss = chosen_nll + beta * jnp.mean(ratio_term)
+    metrics = {
+        "orpo_nll": chosen_nll,
+        "orpo_log_odds": jnp.mean(log_odds),
+        "orpo_ratio": jnp.mean(ratio_term),
+    }
+    return loss, metrics

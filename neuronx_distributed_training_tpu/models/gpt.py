@@ -1,0 +1,407 @@
+"""Megatron-family GPT model, TPU-native.
+
+Functional re-design of the reference's Megatron model source
+(``models/megatron/gpt_model.py`` + ``language_model.py`` + ``transformer.py``,
+~3500 LoC of NeMo-Megatron-on-NxD): the architecture-knob surface of
+``megatron_gpt_model.py:79-147`` reduced to the knobs that change math —
+
+- position embedding: ``rope`` | ``learned_absolute``
+  (``language_model.py:194-328`` Embedding + RotaryEmbedding);
+- normalization: ``layernorm`` (with bias) | ``rmsnorm``
+  (``fused_layer_norm.py:14-36``);
+- activation: ``gelu`` | ``swiglu`` | ``geglu`` | ``reglu``
+  (``transformer.py:89-245`` ParallelMLP variants);
+- biased linears (Megatron default) vs bias-free;
+- GQA / MQA via ``num_query_groups`` (``transformer.py:470-777``);
+- optional sliding-window attention; dropout (embedding/hidden) with explicit
+  PRNG threading;
+- MoE layers (``NeuronSwitchMLP``, ``transformer.py:376-467``) via
+  ``ops.moe`` with top-k or sinkhorn routing.
+
+Pre-LN transformer blocks (the reference's default ``pre_ln``); loss is the
+same vocab-parallel CE as Llama.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_training_tpu.ops import cross_entropy as ce_ops
+from neuronx_distributed_training_tpu.ops import attention as attn_ops
+from neuronx_distributed_training_tpu.ops import linear as linear_ops
+from neuronx_distributed_training_tpu.ops import moe as moe_ops
+from neuronx_distributed_training_tpu.ops import norm as norm_ops
+from neuronx_distributed_training_tpu.ops import rope as rope_ops
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """The ``megatron`` ``model:`` block (reference ``megatron_gpt_model.py:79-147``)."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*h (or 8/3*h for glu acts)
+    num_layers: int = 12
+    num_attention_heads: int = 16
+    num_query_groups: Optional[int] = None  # GQA; 1 = MQA; None = MHA
+    max_position_embeddings: int = 2048
+    position_embedding_type: str = "rope"  # "rope" | "learned_absolute"
+    rotary_percentage: float = 1.0
+    rope_theta: float = 10000.0
+    normalization: str = "layernorm"  # "layernorm" | "rmsnorm"
+    layernorm_epsilon: float = 1e-5
+    activation: str = "gelu"  # "gelu" | "swiglu" | "geglu" | "reglu"
+    bias: bool = True
+    hidden_dropout: float = 0.0
+    embedding_dropout: float = 0.0
+    sliding_window: Optional[int] = None
+    share_embeddings_and_output_weights: bool = True  # Megatron default tying
+    initializer_range: float = 0.02
+    attention_impl: str = "core"
+    sequence_parallel: bool = False
+    activations_checkpoint_granularity: Optional[str] = "selective"
+    # MoE (NeuronSwitchMLP equivalent); None -> dense
+    moe: Optional[moe_ops.MoEConfig] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_query_groups or self.num_attention_heads
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.ffn_hidden_size:
+            return self.ffn_hidden_size
+        return 4 * self.hidden_size
+
+    @property
+    def is_glu(self) -> bool:
+        return self.activation in ("swiglu", "geglu", "reglu")
+
+    @classmethod
+    def from_config(cls, model_cfg: dict[str, Any], ds_cfg: dict[str, Any] | None = None):
+        m = dict(model_cfg or {})
+        ds = dict(ds_cfg or {})
+        fusions = dict(m.get("fusions", {}) or {})
+        moe_block = m.get("moe") or (
+            {"num_experts": m["num_moe_experts"]} if m.get("num_moe_experts") else None
+        )
+        return cls(
+            vocab_size=int(m.get("vocab_size", 50257)),
+            hidden_size=int(m.get("hidden_size", 1024)),
+            ffn_hidden_size=m.get("ffn_hidden_size"),
+            num_layers=int(m.get("num_layers", 12)),
+            num_attention_heads=int(m.get("num_attention_heads", 16)),
+            num_query_groups=m.get("num_query_groups", m.get("num_kv_heads")),
+            max_position_embeddings=int(m.get("max_position_embeddings", 2048)),
+            position_embedding_type=str(m.get("position_embedding_type", "rope")),
+            rotary_percentage=float(m.get("rotary_percentage", 1.0)),
+            rope_theta=float(m.get("rotary_base", m.get("rope_theta", 10000.0))),
+            normalization=str(m.get("normalization", "layernorm")),
+            layernorm_epsilon=float(m.get("layernorm_epsilon", 1e-5)),
+            activation=str(m.get("activation", "gelu")),
+            bias=bool(m.get("bias", True)),
+            hidden_dropout=float(m.get("hidden_dropout", 0.0)),
+            embedding_dropout=float(m.get("embedding_dropout", m.get("hidden_dropout", 0.0))),
+            sliding_window=m.get("window_size", m.get("sliding_window")),
+            share_embeddings_and_output_weights=bool(
+                m.get("share_embeddings_and_output_weights", True)
+            ),
+            attention_impl="flash" if fusions.get("flash_attention") else "core",
+            sequence_parallel=bool(ds.get("sequence_parallel", False)),
+            activations_checkpoint_granularity=m.get(
+                "activations_checkpoint_granularity", "selective"
+            ),
+            moe=moe_ops.MoEConfig.from_config(moe_block) if moe_block else None,
+        )
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _norm_init(cfg: GPTConfig, dtype):
+    if cfg.normalization == "rmsnorm":
+        return norm_ops.init_rms_norm(cfg.hidden_size, dtype=dtype)[0]
+    return norm_ops.init_layer_norm(cfg.hidden_size, dtype=dtype)[0]
+
+
+def _apply_norm(cfg: GPTConfig, params, x):
+    if cfg.normalization == "rmsnorm":
+        return norm_ops.apply_rms_norm(params, x, eps=cfg.layernorm_epsilon)
+    return norm_ops.apply_layer_norm(params, x, eps=cfg.layernorm_epsilon)
+
+
+def _init_layer(key: jax.Array, cfg: GPTConfig, dtype):
+    keys = jax.random.split(key, 6)
+    h, d = cfg.hidden_size, cfg.head_size
+    nh, nkv = cfg.num_attention_heads, cfg.kv_heads
+    std = cfg.initializer_range
+    bias = cfg.bias
+    p: dict[str, Any] = {
+        "input_norm": _norm_init(cfg, dtype),
+        "post_attn_norm": _norm_init(cfg, dtype),
+    }
+    p["attn"] = {
+        "qkv": linear_ops.init_linear(
+            keys[0], h, (nh + 2 * nkv) * d, shard="column", dtype=dtype,
+            stddev=std, use_bias=bias,
+        )[0],
+        "o": linear_ops.init_linear(
+            keys[1], nh * d, h, shard="row", dtype=dtype, stddev=std, use_bias=bias
+        )[0],
+    }
+    if cfg.moe is not None:
+        p["mlp"] = moe_ops.init_moe_params(
+            keys[2], h, cfg.ffn_size, cfg.moe, dtype=dtype, stddev=std
+        )
+    else:
+        width = 2 * cfg.ffn_size if cfg.is_glu else cfg.ffn_size
+        p["mlp"] = {
+            "up": linear_ops.init_linear(
+                keys[2], h, width, shard="column", dtype=dtype, stddev=std,
+                use_bias=bias,
+            )[0],
+            "down": linear_ops.init_linear(
+                keys[3], cfg.ffn_size, h, shard="row", dtype=dtype, stddev=std,
+                use_bias=bias,
+            )[0],
+        }
+    return p
+
+
+def init_params(key: jax.Array, cfg: GPTConfig, policy: DtypePolicy | None = None):
+    policy = policy or DtypePolicy()
+    dtype = policy.param_dtype
+    kemb, kpos, klayers, khead = jax.random.split(key, 4)
+    params: dict[str, Any] = {}
+    params["embed"], _ = linear_ops.init_embedding(
+        kemb, cfg.vocab_size, cfg.hidden_size, dtype=dtype, stddev=cfg.initializer_range
+    )
+    if cfg.position_embedding_type == "learned_absolute":
+        params["pos_embed"] = {
+            "embedding": (
+                cfg.initializer_range
+                * jax.random.truncated_normal(
+                    kpos, -2.0, 2.0, (cfg.max_position_embeddings, cfg.hidden_size)
+                )
+            ).astype(dtype)
+        }
+    layer_keys = jax.random.split(klayers, cfg.num_layers)
+    params["layers"] = jax.vmap(lambda k: _init_layer(k, cfg, dtype))(layer_keys)
+    params["final_norm"] = _norm_init(cfg, dtype)
+    if not cfg.share_embeddings_and_output_weights:
+        params["lm_head"], _ = linear_ops.init_linear(
+            khead, cfg.hidden_size, cfg.vocab_size, shard="column", dtype=dtype,
+            stddev=cfg.initializer_range,
+        )
+    return params
+
+
+def _norm_specs(cfg: GPTConfig):
+    if cfg.normalization == "rmsnorm":
+        return {"scale": P(None)}
+    return {"scale": P(None), "bias": P(None)}
+
+
+def param_specs(cfg: GPTConfig, *, pipeline: bool = False):
+    n = _norm_specs(cfg)
+    attn: dict[str, Any] = {
+        "qkv": {"w": P(None, "model")},
+        "o": {"w": P("model", None)},
+    }
+    if cfg.bias:
+        attn["qkv"]["bias"] = P("model")
+        attn["o"]["bias"] = P(None)
+    if cfg.moe is not None:
+        mlp = moe_ops.moe_param_specs(cfg.moe)
+    else:
+        mlp = {"up": {"w": P(None, "model")}, "down": {"w": P("model", None)}}
+        if cfg.bias:
+            mlp["up"]["bias"] = P("model")
+            mlp["down"]["bias"] = P(None)
+    layer = {"input_norm": n, "post_attn_norm": n, "attn": attn, "mlp": mlp}
+    lead = "pipe" if pipeline else None
+    stacked = jax.tree_util.tree_map(
+        lambda s: P(*((lead,) + tuple(s))), layer, is_leaf=lambda x: isinstance(x, P)
+    )
+    specs: dict[str, Any] = {
+        "embed": {"embedding": P("model", None)},
+        "layers": stacked,
+        "final_norm": _norm_specs(cfg),
+    }
+    if cfg.position_embedding_type == "learned_absolute":
+        specs["pos_embed"] = {"embedding": P(None, None)}
+    if not cfg.share_embeddings_and_output_weights:
+        specs["lm_head"] = {"w": P(None, "model")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _activation(cfg: GPTConfig, x: jax.Array) -> jax.Array:
+    if cfg.is_glu:
+        a, b = jnp.split(x, 2, axis=-1)
+        gate = {"swiglu": jax.nn.silu, "geglu": jax.nn.gelu,
+                "reglu": jax.nn.relu}[cfg.activation](a)
+        return gate * b
+    return jax.nn.gelu(x)
+
+
+def _dropout(x, rate, key):
+    if rate <= 0.0 or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+def _attention_block(cfg, lp, x, cos, sin, policy):
+    b, s, h = x.shape
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    qkv = linear_ops.apply_linear(lp["qkv"], x)
+    q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
+    q = q.reshape(b, s, nh, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+    q = shd.constrain(q, shd.heads_spec(False))
+    if cos is not None:
+        if cfg.rotary_percentage < 1.0:
+            rot = int(d * cfg.rotary_percentage) // 2 * 2
+            q = jnp.concatenate(
+                [rope_ops.apply_rope(q[..., :rot], cos, sin), q[..., rot:]], -1
+            )
+            k = jnp.concatenate(
+                [rope_ops.apply_rope(k[..., :rot], cos, sin), k[..., rot:]], -1
+            )
+        else:
+            q = rope_ops.apply_rope(q, cos, sin)
+            k = rope_ops.apply_rope(k, cos, sin)
+    out = attn_ops.attention(
+        q, k, v, impl=cfg.attention_impl, causal=True,
+        sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
+    )
+    return linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
+
+
+def _mlp_block(cfg, lp, x, policy):
+    if cfg.moe is not None:
+        y, aux = moe_ops.moe_block(lp, x, cfg.moe, compute_dtype=policy.compute_dtype)
+        aux_loss = moe_ops.load_balancing_loss(
+            aux["router_logits"], aux["expert_idx"], cfg.moe
+        )
+        return y, aux_loss
+    y = linear_ops.apply_linear(lp["up"], x)
+    y = _activation(cfg, y)
+    return linear_ops.apply_linear(lp["down"], y), jnp.zeros((), jnp.float32)
+
+
+def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key):
+    aspec = shd.act_spec(cfg.sequence_parallel, False)
+    k1 = k2 = None
+    if dropout_key is not None:
+        k1, k2 = jax.random.split(dropout_key)
+    residual = x
+    hidden = _apply_norm(cfg, lp["input_norm"], x)
+    hidden = _attention_block(cfg, lp["attn"], hidden, cos, sin, policy)
+    x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k1), aspec)
+    residual = x
+    hidden = _apply_norm(cfg, lp["post_attn_norm"], x)
+    hidden, aux_loss = _mlp_block(cfg, lp["mlp"], hidden, policy)
+    x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k2), aspec)
+    return x, aux_loss
+
+
+def forward(
+    params,
+    batch: dict[str, jax.Array],
+    cfg: GPTConfig,
+    policy: DtypePolicy,
+    *,
+    rng: Optional[jax.Array] = None,  # dropout PRNG; None = eval/deterministic
+    shift_labels: bool = True,
+    return_logits: bool = False,
+):
+    """Causal-LM forward -> (loss, aux) (or (logits, aux) without labels)."""
+    input_ids = batch["input_ids"]
+    b, s = input_ids.shape
+    aspec = shd.act_spec(cfg.sequence_parallel, False)
+    x = linear_ops.apply_embedding(
+        params["embed"], input_ids, compute_dtype=policy.compute_dtype
+    )
+    if cfg.position_embedding_type == "learned_absolute":
+        pos = jnp.arange(s)
+        x = x + jnp.take(params["pos_embed"]["embedding"], pos, axis=0).astype(
+            x.dtype
+        )[None]
+        cos = sin = None
+    else:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+        rot_dim = int(cfg.head_size * cfg.rotary_percentage) // 2 * 2
+        inv_freq = rope_ops.rope_frequencies(rot_dim, theta=cfg.rope_theta)
+        cos, sin = rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
+    if rng is not None:
+        rng, kemb = jax.random.split(rng)
+        x = _dropout(x, cfg.embedding_dropout, kemb)
+    x = shd.constrain(x, aspec)
+
+    layer_stack = policy.cast_to_compute(params["layers"])
+    layer_keys = (
+        jax.random.split(rng, cfg.num_layers) if rng is not None else None
+    )
+
+    def body(carry, inp):
+        x, aux_acc = carry
+        if layer_keys is not None:
+            lp, lkey = inp
+        else:
+            lp, lkey = inp, None
+        x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey)
+        return (x, aux_acc + aux), None
+
+    from neuronx_distributed_training_tpu.models.llama import _remat_policy
+
+    remat = _remat_policy(cfg.activations_checkpoint_granularity)
+    if remat is not None:
+        body = jax.checkpoint(body, policy=remat, prevent_cse=False)
+    xs = (layer_stack, layer_keys) if layer_keys is not None else layer_stack
+    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
+    hidden = _apply_norm(cfg, params["final_norm"], x)
+
+    if cfg.share_embeddings_and_output_weights:
+        w = params["embed"]["embedding"].astype(policy.compute_dtype)
+        logits = hidden @ w.T
+    else:
+        logits = linear_ops.apply_linear(
+            params["lm_head"], hidden, compute_dtype=policy.compute_dtype
+        )
+    logits = shd.constrain(logits, shd.logits_spec(False))
+
+    aux: dict[str, Any] = {}
+    if cfg.moe is not None:
+        aux["router_aux_loss"] = aux_sum / cfg.num_layers
+    if return_logits:
+        aux["logits"] = logits
+    labels = batch.get("labels")
+    if labels is None:
+        return logits, aux
+    loss_mask = batch.get("loss_mask")
+    if shift_labels:
+        logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
+    loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)
+    if cfg.moe is not None:
+        loss = loss + cfg.moe.router_aux_loss_coef * aux["router_aux_loss"]
+    return loss, aux

@@ -208,11 +208,14 @@ def _layer_specs(cfg: LlamaConfig):
     }
 
 
-def param_specs(cfg: LlamaConfig):
-    """PartitionSpec pytree matching ``init_params`` output."""
-    # prepend the stacked-layer dim (replicated)
+def param_specs(cfg: LlamaConfig, *, pipeline: bool = False):
+    """PartitionSpec pytree matching ``init_params`` output.
+
+    ``pipeline=True`` shards the stacked-layer dim over ``pipe`` — that single
+    spec change IS the pipeline partitioning (equal cuts at layer granularity,
+    the reference's ``auto_partition``, ``base.py:136-157``)."""
     stacked = jax.tree_util.tree_map(
-        lambda s: P(*((None,) + tuple(s))), _layer_specs(cfg),
+        lambda s: P(*(("pipe" if pipeline else None,) + tuple(s))), _layer_specs(cfg),
         is_leaf=lambda x: isinstance(x, P),
     )
     specs: dict[str, Any] = {
@@ -335,6 +338,65 @@ def logits_fn(params, hidden: jax.Array, cfg: LlamaConfig, policy: DtypePolicy) 
             params["lm_head"], hidden, compute_dtype=policy.compute_dtype
         )
     return shd.constrain(logits, shd.logits_spec(cfg.context_parallel))
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel hooks (parallel/pipeline.py contract)
+# ---------------------------------------------------------------------------
+
+
+def _rope_for(input_ids: jax.Array, cfg: LlamaConfig):
+    positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, input_ids.shape)
+    inv_freq = rope_ops.rope_frequencies(
+        cfg.head_size,
+        theta=cfg.rope_theta,
+        position_interpolation_factor=cfg.rope_interpolation_factor,
+    )
+    return rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
+
+
+def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy):
+    """(embed_fn, stage_fn, loss_fn) for ``parallel.pipeline.pipeline_loss``.
+
+    The decoder stack is the pipelined region; embedding and lm-head/loss run
+    outside it (replicated over ``pipe``, still TP-sharded), replacing the
+    reference's stage-0/stage-N module placement + ``run_train`` engine
+    (``base.py:374-383``).
+    """
+    aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
+
+    def embed_fn(params, mb):
+        x = linear_ops.apply_embedding(
+            params["embed"], mb["input_ids"], compute_dtype=policy.compute_dtype
+        )
+        return shd.constrain(x, aspec)
+
+    def stage_fn(local_layers, x, mb):
+        cos, sin = _rope_for(mb["input_ids"], cfg)
+        local_layers = policy.cast_to_compute(local_layers)
+
+        def body(carry, lp):
+            return _decoder_layer(lp, carry, cos, sin, cfg, policy), None
+
+        x, _ = jax.lax.scan(body, x, local_layers)
+        return x
+
+    def loss_fn(params, y, mb):
+        h = norm_ops.apply_rms_norm(params["final_norm"], y, eps=cfg.rms_norm_eps)
+        logits = logits_fn(params, h, cfg, policy)
+        labels = mb["labels"]
+        loss_mask = mb.get("loss_mask")
+        logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
+        loss_sum = ce_ops.cross_entropy_loss(
+            logits, labels, loss_mask=loss_mask, reduction="sum"
+        )
+        valid = (labels != -100).astype(jnp.float32)
+        if loss_mask is not None:
+            valid = valid * loss_mask.astype(jnp.float32)
+        return loss_sum, jnp.sum(valid)
+
+    return embed_fn, stage_fn, loss_fn
 
 
 def forward(

@@ -1,0 +1,177 @@
+"""Mixtral-family decoder (MoE), TPU-native.
+
+Functional re-design of the reference's ``models/hf_models/modeling_mixtral.py``
+(893 LoC): Llama-style attention blocks (sliding-window causal) with the MLP
+replaced by a routed mixture of SwiGLU experts, the router-logit threading that
+feeds the load-balancing aux loss (reference ``modeling_mixtral.py:440-549``
+threads ``past_router_logits`` through layers; here the scan carry accumulates
+the per-layer aux loss directly, which is PP-friendly for the same reason), and
+``router_aux_loss_coef`` scaling at the loss (``modeling_mixtral.py:872-878``).
+
+Shares the attention/norm/rope machinery with ``models.llama`` — the decoder
+differs only in the MLP slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.ops import cross_entropy as ce_ops
+from neuronx_distributed_training_tpu.ops import linear as linear_ops
+from neuronx_distributed_training_tpu.ops import moe as moe_ops
+from neuronx_distributed_training_tpu.ops import norm as norm_ops
+from neuronx_distributed_training_tpu.ops import rope as rope_ops
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    """Mixtral architecture = Llama knobs + MoE block + sliding window
+    (reference ``mixtral_model.py:24-96``, ``hf_mixtral_8x7b_config.yaml``)."""
+
+    llama: llama.LlamaConfig = dataclasses.field(default_factory=llama.LlamaConfig)
+    moe: moe_ops.MoEConfig = dataclasses.field(default_factory=moe_ops.MoEConfig)
+    moe_frequency: int = 1  # every Nth layer is MoE; 1 = all (Mixtral)
+
+    # architecture passthroughs (perf estimation, data-module sizing)
+    @property
+    def vocab_size(self) -> int:
+        return self.llama.vocab_size
+
+    @property
+    def hidden_size(self) -> int:
+        return self.llama.hidden_size
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.llama.intermediate_size
+
+    @property
+    def num_layers(self) -> int:
+        return self.llama.num_layers
+
+    @property
+    def num_attention_heads(self) -> int:
+        return self.llama.num_attention_heads
+
+    @property
+    def num_kv_heads(self):
+        return self.llama.num_kv_heads
+
+    @classmethod
+    def from_config(cls, model_cfg: dict[str, Any], ds_cfg: dict[str, Any] | None = None):
+        m = dict(model_cfg or {})
+        base = llama.LlamaConfig.from_config(m, ds_cfg)
+        # Mixtral defaults that differ from Llama
+        if m.get("sliding_window") is None and m.get("use_sliding_window", False):
+            base = dataclasses.replace(base, sliding_window=4096)
+        return cls(
+            llama=base,
+            moe=moe_ops.MoEConfig.from_config(m.get("moe", {})),
+            moe_frequency=int(m.get("moe", {}).get("frequency", 1) or 1),
+        )
+
+
+def init_params(key: jax.Array, cfg: MixtralConfig, policy: DtypePolicy | None = None):
+    """Llama skeleton with each layer's dense MLP replaced by router+experts."""
+    if cfg.moe_frequency != 1:
+        raise NotImplementedError(
+            "moe_frequency > 1 (dense/MoE interleave) not yet supported"
+        )
+    policy = policy or DtypePolicy()
+    dtype = policy.param_dtype
+    lc = cfg.llama
+    params = llama.init_params(key, lc, policy)
+
+    def init_layer_moe(k):
+        return moe_ops.init_moe_params(
+            k, lc.hidden_size, lc.intermediate_size, cfg.moe,
+            dtype=dtype, stddev=lc.initializer_range,
+        )
+
+    moe_keys = jax.random.split(jax.random.fold_in(key, 999), lc.num_layers)
+    params["layers"]["mlp"] = jax.vmap(init_layer_moe)(moe_keys)
+    return params
+
+
+def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
+    specs = llama.param_specs(cfg.llama, pipeline=pipeline)
+    lead = "pipe" if pipeline else None
+    moe_specs = moe_ops.moe_param_specs(cfg.moe)
+    specs["layers"]["mlp"] = jax.tree_util.tree_map(
+        lambda s: P(*((lead,) + tuple(s))), moe_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return specs
+
+
+def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy):
+    """Pre-LN attention + MoE block; returns (x, aux_loss)."""
+    lc = cfg.llama
+    aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
+    residual = x
+    hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
+    hidden = llama._attention_block(lp["attn"], hidden, cos, sin, lc, policy)
+    x = shd.constrain(residual + hidden, aspec)
+    residual = x
+    hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
+    hidden, aux = moe_ops.moe_block(
+        lp["mlp"], hidden, cfg.moe, compute_dtype=policy.compute_dtype
+    )
+    aux_loss = moe_ops.load_balancing_loss(aux["router_logits"], aux["expert_idx"], cfg.moe)
+    return shd.constrain(residual + hidden, aspec), aux_loss
+
+
+def forward(
+    params,
+    batch: dict[str, jax.Array],
+    cfg: MixtralConfig,
+    policy: DtypePolicy,
+    *,
+    shift_labels: bool = True,
+    return_logits: bool = False,
+):
+    """Causal-LM forward -> (loss, aux).  Adds ``router_aux_loss_coef`` x mean
+    per-layer load-balancing loss (reference ``modeling_mixtral.py:872-878``)."""
+    lc = cfg.llama
+    input_ids = batch["input_ids"]
+    aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
+    x = linear_ops.apply_embedding(
+        params["embed"], input_ids, compute_dtype=policy.compute_dtype
+    )
+    x = shd.constrain(x, aspec)
+    cos, sin = llama._rope_for(input_ids, lc)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(carry, lp):
+        x, aux_acc = carry
+        x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
+        return (x, aux_acc + aux), None
+
+    remat = llama._remat_policy(lc.activations_checkpoint_granularity)
+    if remat is not None:
+        body = jax.checkpoint(body, policy=remat, prevent_cse=False)
+    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layer_stack)
+    hidden = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
+    logits = llama.logits_fn(params, hidden, lc, policy)
+
+    aux: dict[str, Any] = {"router_aux_loss": aux_sum / lc.num_layers}
+    if return_logits:
+        aux["logits"] = logits
+    labels = batch.get("labels")
+    if labels is None:
+        return logits, aux
+    loss_mask = batch.get("loss_mask")
+    if shift_labels:
+        logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
+    lm_loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)
+    loss = lm_loss + cfg.moe.router_aux_loss_coef * aux["router_aux_loss"]
+    aux["lm_loss"] = lm_loss
+    return loss, aux

@@ -69,6 +69,13 @@ def apply_linear(params, x: jax.Array, *, compute_dtype=None) -> jax.Array:
         w = w.astype(compute_dtype)
         x = x.astype(compute_dtype)
     y = x @ w
+    if "lora_a" in params:
+        # low-rank adapter path (peft/lora.py): y += x @ A @ B * (alpha/r).
+        # scaling is stored in the (tiny, fp32) "lora_scale" leaf so apply
+        # stays a pure function of params.
+        a = params["lora_a"].astype(y.dtype)
+        b = params["lora_b"].astype(y.dtype)
+        y = y + ((x @ a) @ b) * params["lora_scale"].astype(y.dtype)
     if "bias" in params:
         b = params["bias"]
         y = y + (b.astype(y.dtype) if compute_dtype is not None else b)

@@ -107,11 +107,18 @@ def adamw_update(
     lr,
     cfg: AdamWConfig,
     policy: DtypePolicy | None = None,
+    trainable_mask=None,
 ):
-    """One AdamW step. Returns (new_params, new_opt_state, metrics)."""
+    """One AdamW step. Returns (new_params, new_opt_state, metrics).
+
+    ``trainable_mask`` (pytree of 0/1, e.g. ``peft.lora.trainable_mask``)
+    freezes masked-out params completely: no grad, no moment update, no weight
+    decay — the LoRA/PEFT freeze."""
     policy = policy or DtypePolicy()
     step = opt_state["step"] + 1
     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+    if trainable_mask is not None:
+        grads = jax.tree_util.tree_map(lambda g, m: g * m, grads, trainable_mask)
     gnorm = global_norm(grads)
     if cfg.grad_clip_norm is not None and cfg.grad_clip_norm > 0:
         clip = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-6))
@@ -132,6 +139,10 @@ def adamw_update(
         opt_state["nu"],
         grads,
     )
+
+    if trainable_mask is not None:
+        # frozen params get no weight decay either
+        masks = jax.tree_util.tree_map(lambda w, t: w * t, masks, trainable_mask)
 
     def upd(m, mu, nu, wd_mask):
         mf = m.astype(jnp.float32)
@@ -163,32 +174,56 @@ def zero1_leaf_spec(spec: P, shape, mesh: Mesh, dp_axes=("data", "expert")) -> P
     """Extend a param spec with DP sharding on the first unsharded, divisible dim.
 
     This is ZeRO-1: optimizer moments/master weights sharded over the DP group.
-    Falls back to the param spec (replicated over DP) when nothing divides.
+    Axes the param spec already uses (e.g. ``expert`` on MoE weights) are
+    skipped — a mesh axis may appear at most once per spec.  Falls back to the
+    param spec (replicated over DP) when nothing divides.
     """
+    used = {
+        a
+        for e in spec
+        if e is not None
+        for a in (e if isinstance(e, tuple) else (e,))
+    }
+    avail = tuple(
+        a for a in dp_axes if int(mesh.shape.get(a, 1)) > 1 and a not in used
+    )
     dp_total = 1
-    for a in dp_axes:
+    for a in avail:
         dp_total *= int(mesh.shape.get(a, 1))
     if dp_total == 1:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, (e, dim) in enumerate(zip(entries, shape)):
         if e is None and dim % dp_total == 0:
-            entries[i] = tuple(a for a in dp_axes if int(mesh.shape.get(a, 1)) > 1)
-            if len(entries[i]) == 1:
-                entries[i] = entries[i][0]
+            entries[i] = avail if len(avail) > 1 else avail[0]
             return P(*entries)
     return spec
 
 
 def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
-                    policy: DtypePolicy | None = None):
-    """Spec pytree matching ``init_opt_state`` output."""
+                    policy: DtypePolicy | None = None,
+                    zero1_exclude: tuple = ()):
+    """Spec pytree matching ``init_opt_state`` output.
+
+    ``zero1_exclude`` names path substrings whose moments keep the plain param
+    spec (no DP sharding).  Needed for the embedding under pipeline parallelism:
+    XLA's SPMD partitioner CHECK-crashes partitioning the embedding-grad
+    scatter when its consumer is DP-resharded inside the manual ``pipe``
+    submesh (spmd_partitioner_util.cc ExpandDeviceGroupsWithIota) — excluding
+    the embedding sidesteps the compiler bug at negligible memory cost."""
     policy = policy or DtypePolicy()
 
     if zero1:
         shapes = jax.tree_util.tree_map(lambda x: x.shape, params)
-        moment_specs = jax.tree_util.tree_map(
-            lambda s, sh: zero1_leaf_spec(s, sh, mesh),
+
+        def leaf_spec(path, s, sh):
+            p = _path_str(path)
+            if any(x in p for x in zero1_exclude):
+                return s
+            return zero1_leaf_spec(s, sh, mesh)
+
+        moment_specs = jax.tree_util.tree_map_with_path(
+            leaf_spec,
             param_specs,
             shapes,
             is_leaf=lambda x: isinstance(x, P),

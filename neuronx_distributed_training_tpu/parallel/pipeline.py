@@ -1,0 +1,156 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+TPU-native replacement for NxD's pipeline engine (``NxDPPModel.run_train`` —
+reference ``base.py:374-383`` — with its FX tracer/auto-partitioner and 1F1B
+P2P schedule, configured by ``pipeline_config`` at ``base.py:136-157``).
+Re-designed rather than translated:
+
+- **no tracer**: models here are stacked layer pytrees; "partitioning" is just
+  sharding the leading ``[num_layers, ...]`` dim over ``pipe``
+  (``auto_partition`` with equal cuts falls out; manual ``pipeline_cuts`` are
+  unnecessary when stages are equal-sized by construction);
+- **schedule**: microbatches stream through stages inside one jitted
+  ``lax.scan``; stage outputs move over ICI with ``lax.ppermute``.  Forward is
+  the classic GPipe wavefront (num_micro + pp - 1 ticks); **backward is
+  derived by autodiff** — ``scan``/``ppermute`` transpose to the reverse
+  wavefront, giving a full fwd-then-bwd schedule.  Per-stage activations are
+  rematerialized (``jax.checkpoint``) so only stage *inputs* are saved, the
+  same memory class as the reference's 1F1B-with-recompute;
+- **loss on last stage** (reference ``base.py:378-381``): the lm-head/loss
+  hook runs on every rank (SPMD — the non-last ranks compute on garbage and
+  their result is masked), but only the scalar loss crosses ranks (psum), not
+  activations;
+- embedding/head weights live OUTSIDE the pipelined stack and are replicated
+  over ``pipe`` (they are still TP-sharded over ``model`` by GSPMD's auto
+  axes) — a deliberate departure from the reference's stage-0/stage-N
+  placement + embedding-tying all-reduce protocol (``module.py:28-157``).
+
+``shard_map`` is manual over ``pipe`` only (``axis_names={"pipe"}``): data/
+tensor/sequence sharding inside the body remains GSPMD-driven, so the same
+model code runs under any tp x dp combination.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+
+PIPE_AXIS = "pipe"
+
+# EmbedFn:    (params, microbatch_dict) -> activations [mb, s, h]
+# StageFn:    (local_layer_params, activations, microbatch_dict) -> activations
+# LossFn:     (params, activations, microbatch_dict) -> (scalar loss, scalar denom)
+EmbedFn = Callable[[Any, dict], jax.Array]
+StageFn = Callable[[Any, jax.Array, dict], jax.Array]
+LossFn = Callable[[Any, jax.Array, dict], tuple]
+
+
+def stage_layer_slice(num_layers: int, pp: int) -> int:
+    if num_layers % pp != 0:
+        raise ValueError(f"num_layers {num_layers} not divisible by pp {pp}")
+    return num_layers // pp
+
+
+def pipeline_loss(
+    params: Any,
+    layer_params: Any,  # stacked [num_layers, ...]; dim 0 sharded over "pipe"
+    microbatches: dict[str, jax.Array],  # leaves [num_micro, mb, ...]
+    *,
+    embed_fn: EmbedFn,
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Scalar pipeline-parallel loss (mean over microbatches).
+
+    Falls back to a plain sequential microbatch loop when pp == 1, so the same
+    entry point drives both pipelined and unpipelined configs.
+    """
+    mesh = mesh or shd.active_mesh()
+    pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
+    nm = num_microbatches or jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+
+    if pp == 1:
+        def body(acc, mb):
+            x = embed_fn(params, mb)
+            x = stage_fn(layer_params, x, mb)
+            loss, denom = loss_fn(params, x, mb)
+            return (acc[0] + loss, acc[1] + denom), None
+
+        (loss_sum, denom_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), microbatches
+        )
+        return loss_sum / jnp.maximum(denom_sum, 1.0)
+
+    body = functools.partial(
+        _pipeline_body,
+        embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, pp=pp, nm=nm,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        # manual over pipe only: layer stack sharded on dim 0; params and
+        # microbatches replicated across pipe (GSPMD still shards them over
+        # data/model inside)
+        in_specs=(P(), P(PIPE_AXIS), P()),
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+    return fn(params, layer_params, microbatches)
+
+
+def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
+                   loss_fn, pp, nm):
+    """Per-pipe-rank wavefront loop (inside shard_map, manual over "pipe")."""
+    rank = jax.lax.axis_index(PIPE_AXIS)
+    is_first = rank == 0
+    is_last = rank == pp - 1
+
+    mb0 = jax.tree_util.tree_map(lambda x: x[0], microbatches)
+    x0 = embed_fn(params, mb0)  # shape/dtype template for the stream buffer
+
+    # rematerialize stage activations in backward: only stage inputs are saved
+    compute = jax.checkpoint(stage_fn)
+
+    send_perm = [(i, i + 1) for i in range(pp - 1)]  # rank 0 receives zeros
+
+    def tick(carry, t):
+        recv, loss_acc, denom_acc = carry
+        # stage-0 input: microbatch t (clamped; ticks past nm-1 are drain-only)
+        t_in = jnp.clip(t, 0, nm - 1)
+        mb_in = jax.tree_util.tree_map(lambda x: x[t_in], microbatches)
+        fresh = embed_fn(params, mb_in)
+        x = jnp.where(is_first, fresh, recv)
+        y = compute(local_layers, x, mb_in)
+
+        # last stage: microbatch t - (pp-1) exits the pipe at this tick
+        t_out = t - (pp - 1)
+        t_out_c = jnp.clip(t_out, 0, nm - 1)
+        mb_out = jax.tree_util.tree_map(lambda x: x[t_out_c], microbatches)
+        loss, denom = loss_fn(params, y, mb_out)
+        valid = jnp.logical_and(is_last, jnp.logical_and(t_out >= 0, t_out < nm))
+        loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
+        denom_acc = denom_acc + jnp.where(valid, denom, 0.0)
+
+        recv = jax.lax.ppermute(y, PIPE_AXIS, send_perm)
+        return (recv, loss_acc, denom_acc), None
+
+    zeros = jnp.zeros_like(x0)
+    (_, loss_acc, denom_acc), _ = jax.lax.scan(
+        tick,
+        (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        jnp.arange(nm + pp - 1),
+    )
+    # only the last rank's accumulators are real; psum broadcasts the scalars
+    loss_total = jax.lax.psum(loss_acc, PIPE_AXIS)
+    denom_total = jax.lax.psum(denom_acc, PIPE_AXIS)
+    return loss_total / jnp.maximum(denom_total, 1.0)

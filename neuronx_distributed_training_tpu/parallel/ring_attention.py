@@ -41,9 +41,15 @@ NEG_INF = -1e30
 def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale, causal):
     """One online-softmax accumulation step against KV chunk (kc, vc).
 
-    q [b, h, sq, d]; kc/vc [b, h, skv, d]; o_acc [b, h, sq, d];
-    m_acc/l_acc [b, h, sq, 1].  Offsets are traced scalars (global positions).
+    q [b, h, sq, d]; kc/vc [b, kvh, skv, d] (un-repeated GQA heads — repeated
+    here, inside the remat boundary, so the ring rotates and the scan carries
+    only kvh heads); o_acc [b, h, sq, d]; m_acc/l_acc [b, h, sq, 1].
+    Offsets are traced scalars (global positions).
     """
+    h, kvh = q.shape[1], kc.shape[1]
+    if kvh != h:
+        kc = jnp.repeat(kc, h // kvh, axis=1)
+        vc = jnp.repeat(vc, h // kvh, axis=1)
     s = jax.lax.dot_general(
         q, kc, (((3,), (3,)), ((0, 1), (0, 1))), preferred_element_type=jnp.float32
     ) * scale  # [b, h, sq, skv]
@@ -69,13 +75,7 @@ def _ring_local(q, k, v, *, axis_name, cp, causal):
 
     q [b, sq, h, d]; k/v [b, skv, kvh, d] (local chunks) -> o [b, sq, h, d].
     """
-    from neuronx_distributed_training_tpu.ops.attention import repeat_kv
-
     b, sq, h, d = q.shape
-    kvh = k.shape[2]
-    if kvh != h:
-        k = repeat_kv(k, h // kvh)
-        v = repeat_kv(v, h // kvh)
     skv = k.shape[1]
     my = jax.lax.axis_index(axis_name)
     q_off = my * sq

@@ -54,13 +54,24 @@ def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
 
 
 def constrain(x, spec: Optional[P], mesh: Optional[Mesh] = None):
-    """``with_sharding_constraint`` if a mesh is active, else identity."""
+    """``with_sharding_constraint`` if a mesh is active, else identity.
+
+    Prefers the bare-PartitionSpec form, which resolves against the *context*
+    mesh — required inside ``shard_map`` regions (e.g. the pipeline body, which
+    is Manual over ``pipe``), where a NamedSharding built from the outer
+    all-Auto mesh would conflict.  Falls back to an explicit NamedSharding when
+    no context mesh is set.
+    """
     if spec is None:
         return x
-    m = mesh or active_mesh()
-    if m is None:
-        return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # no context mesh (plain jit under the legacy `with mesh:` manager)
+        m = mesh or active_mesh()
+        if m is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
 
 
 def seq_axes(sequence_parallel: bool, context_parallel: bool):

@@ -1,0 +1,9 @@
+"""PEFT — parameter-efficient fine-tuning (LoRA)."""
+
+from neuronx_distributed_training_tpu.peft.lora import (  # noqa: F401
+    LoraConfig,
+    add_lora,
+    lora_param_specs,
+    merge_lora,
+    trainable_mask,
+)

@@ -1,0 +1,178 @@
+"""LoRA — low-rank adapters as a pytree transform.
+
+The reference wraps target nn.Modules with NxD's LoRA machinery
+(``nxd.modules.lora.LoraConfig`` built at reference ``llama_model.py:51-65``,
+with ``lora_rank/lora_alpha/lora_dropout/target_modules`` and save/merge
+options).  TPU-native: LoRA is a *pytree transform* —
+
+- ``add_lora`` injects ``lora_a``/``lora_b``/``lora_scale`` leaves into every
+  linear param-dict whose tree path matches a target-module name;
+  ``ops.linear.apply_linear`` picks them up automatically, so NO model code
+  changes;
+- ``trainable_mask`` marks adapter leaves trainable and base weights frozen —
+  the optimizer multiplies grads by this mask (the freeze);
+- ``merge_lora`` folds ``w + A @ B * scale`` back into the base weight for
+  export (the reference's ``save_lora_config_adapter``/merge options);
+- sharding: A ``[in, r]`` follows the input dim of the base spec, B ``[r, out]``
+  the output dim, so TP layouts (column/row) keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# default targets mirror the reference's config surface
+# (config_overview.rst: target_modules: [qkv_proj] etc.)
+DEFAULT_TARGETS = ("qkv", "q", "k", "v", "o", "gate_up", "down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Mirrors the reference's ``model.lora`` YAML block (``llama_model.py:51-65``)."""
+
+    rank: int = 16
+    alpha: float = 32.0
+    dropout: float = 0.0  # dropout on the adapter input (applied by caller RNG)
+    target_modules: tuple = DEFAULT_TARGETS
+
+    @classmethod
+    def from_config(cls, lora_cfg: dict[str, Any]) -> "LoraConfig":
+        c = dict(lora_cfg or {})
+        targets = c.get("target_modules")
+        return cls(
+            rank=int(c.get("lora_rank", c.get("rank", 16))),
+            alpha=float(c.get("lora_alpha", c.get("alpha", 32.0))),
+            dropout=float(c.get("lora_dropout", c.get("dropout", 0.0))),
+            target_modules=tuple(
+                t.replace("_proj", "") for t in targets
+            ) if targets else DEFAULT_TARGETS,
+        )
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _is_target_linear(path, leaf_dict) -> bool:
+    return isinstance(leaf_dict, dict) and "w" in leaf_dict and hasattr(
+        leaf_dict["w"], "ndim"
+    ) and leaf_dict["w"].ndim >= 2
+
+
+def _path_names(path) -> list[str]:
+    return [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+
+
+def add_lora(params: Any, cfg: LoraConfig, key: jax.Array) -> Any:
+    """Return params with adapters injected into matching linear dicts.
+
+    Matching: the linear's dict key (e.g. ``qkv``, ``o``, ``gate_up``) is in
+    ``cfg.target_modules``.  A is gaussian-init, B zero-init (adapter starts as
+    identity), per standard LoRA.  Works on stacked layer dicts (leading
+    ``[num_layers]`` dim) transparently.
+    """
+    counter = [0]
+
+    def visit(path, node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if (
+                isinstance(v, dict)
+                and k in cfg.target_modules
+                and "w" in v
+                and getattr(v["w"], "ndim", 0) >= 2
+            ):
+                w = v["w"]
+                *lead, in_dim, out_dim = w.shape
+                counter[0] += 1
+                ka = jax.random.fold_in(key, counter[0])
+                a = (0.02 * jax.random.truncated_normal(
+                    ka, -2.0, 2.0, (*lead, in_dim, cfg.rank), jnp.float32
+                )).astype(w.dtype)
+                b = jnp.zeros((*lead, cfg.rank, out_dim), w.dtype)
+                out[k] = {
+                    **v,
+                    "lora_a": a,
+                    "lora_b": b,
+                    # scale carries the stacked-layer lead dims so lax.scan can
+                    # slice it per layer alongside a/b
+                    "lora_scale": jnp.full(tuple(lead), cfg.scale, jnp.float32),
+                }
+            else:
+                out[k] = visit(path + [k], v)
+        return out
+
+    return visit([], params)
+
+
+def lora_param_specs(param_specs: Any, cfg: LoraConfig) -> Any:
+    """Extend a spec pytree with adapter specs.
+
+    For a base weight spec ``(..., in_ax, out_ax)``: A gets ``(..., in_ax,
+    None)``, B gets ``(..., None, out_ax)`` — preserving column/row TP layouts.
+    """
+
+    def visit(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict) and "w" in v and isinstance(v["w"], P) and (
+                k in cfg.target_modules
+            ):
+                wspec = tuple(v["w"])
+                lead = wspec[:-2] if len(wspec) >= 2 else ()
+                in_ax = wspec[-2] if len(wspec) >= 2 else None
+                out_ax = wspec[-1] if len(wspec) >= 1 else None
+                out[k] = {
+                    **v,
+                    "lora_a": P(*lead, in_ax, None),
+                    "lora_b": P(*lead, None, out_ax),
+                    "lora_scale": P(*(None for _ in lead)),
+                }
+            else:
+                out[k] = visit(v)
+        return out
+
+    return visit(param_specs)
+
+
+def trainable_mask(params: Any) -> Any:
+    """1.0 for adapter A/B leaves, 0.0 elsewhere (the LoRA freeze).
+
+    ``lora_scale`` stays frozen: it encodes the configured alpha/r, not a
+    learnable parameter."""
+
+    def leaf(path, x):
+        names = _path_names(path)
+        return 1.0 if any(n in ("lora_a", "lora_b") for n in names) else 0.0
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def merge_lora(params: Any) -> Any:
+    """Fold adapters into base weights (export / the reference's merge option)."""
+
+    def visit(node):
+        if not isinstance(node, dict):
+            return node
+        if "lora_a" in node and "w" in node:
+            w = node["w"]
+            delta = jnp.einsum(
+                "...ir,...ro->...io",
+                node["lora_a"].astype(jnp.float32),
+                node["lora_b"].astype(jnp.float32),
+            ) * node["lora_scale"][..., None, None]
+            merged = {k: v for k, v in node.items() if not k.startswith("lora_")}
+            merged["w"] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+            return merged
+        return {k: visit(v) for k, v in node.items()}
+
+    return visit(params)

@@ -1,0 +1,1 @@
+"""Offline tooling: checkpoint converters, eval harness."""

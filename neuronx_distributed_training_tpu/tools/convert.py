@@ -1,0 +1,184 @@
+"""Checkpoint converters: HF Llama/Mixtral <-> native param pytrees.
+
+The reference ships CLI converters over NxD's ``CheckpointConverterBase``
+(``checkpoint_converter.py:1-53``: HF full-state <-> TP/PP-sharded xser, GQA
+``kv_size_multiplier`` interleaving; ``hf_nxdt_mixtral_ckpt_converter.py:26-91``:
+per-expert w1/w2/w3 stacked into fused expert tensors).  TPU-native there is no
+rank-sharded file layout to reproduce — the native format is ONE logical pytree
+(Orbax shards storage transparently) — so conversion is pure tensor-name/layout
+mapping:
+
+HF Llama -> native:
+  model.embed_tokens.weight [V,H]            -> embed.embedding [V,H]
+  layers.i.self_attn.{q,k,v}_proj.weight     -> layers.attn.qkv.w [i,H,(nh+2kv)d]
+  layers.i.self_attn.o_proj.weight [H,H]     -> layers.attn.o.w [i,H,H] (T)
+  layers.i.mlp.{gate,up}_proj.weight         -> layers.mlp.gate_up.w [i,H,2F] (T, fused)
+  layers.i.mlp.down_proj.weight [H,F]        -> layers.mlp.down.w [i,F,H] (T)
+  layers.i.{input,post_attention}_layernorm  -> layers.{input,post_attn}_norm.scale
+  model.norm.weight                          -> final_norm.scale
+  lm_head.weight [V,H]                       -> lm_head.w [H,V] (T)
+
+Mixtral adds: block_sparse_moe.gate.weight -> mlp.router.w; experts.j.{w1,w3}
+stacked+fused -> mlp.experts.gate_up [i,E,H,2F]; w2 -> mlp.experts.down [i,E,F,H].
+
+All weights transpose from torch's [out,in] to the MXU-friendly [in,out].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+def _t(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+def _stack(layers: list[dict[str, Any]]) -> dict[str, Any]:
+    """list of per-layer dicts -> dict of stacked arrays (leading layer dim)."""
+    out: dict[str, Any] = {}
+    for k in layers[0]:
+        vals = [l[k] for l in layers]
+        if isinstance(vals[0], dict):
+            out[k] = _stack(vals)
+        else:
+            out[k] = np.stack(vals)
+    return out
+
+
+def _unstack(tree: dict[str, Any], i: int) -> dict[str, Any]:
+    return {
+        k: (_unstack(v, i) if isinstance(v, dict) else np.asarray(v[i]))
+        for k, v in tree.items()
+    }
+
+
+def hf_llama_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
+    """HF Llama state_dict (name -> array-like) -> native param pytree.
+
+    ``cfg`` is a ``models.llama.LlamaConfig`` (``fuse_qkv`` must be True — the
+    native layout fuses QKV and gate/up, reference ``modeling_llama.py:296-308``).
+    """
+    g = lambda name: np.asarray(state[name])
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        qkv = np.concatenate(
+            [_t(g(pre + "self_attn.q_proj.weight")),
+             _t(g(pre + "self_attn.k_proj.weight")),
+             _t(g(pre + "self_attn.v_proj.weight"))], axis=1,
+        )
+        gate_up = np.concatenate(
+            [_t(g(pre + "mlp.gate_proj.weight")), _t(g(pre + "mlp.up_proj.weight"))],
+            axis=1,
+        )
+        layers.append({
+            "input_norm": {"scale": g(pre + "input_layernorm.weight")},
+            "post_attn_norm": {"scale": g(pre + "post_attention_layernorm.weight")},
+            "attn": {"qkv": {"w": qkv}, "o": {"w": _t(g(pre + "self_attn.o_proj.weight"))}},
+            "mlp": {"gate_up": {"w": gate_up}, "down": {"w": _t(g(pre + "mlp.down_proj.weight"))}},
+        })
+    params: dict[str, Any] = {
+        "embed": {"embedding": g("model.embed_tokens.weight")},
+        "layers": _stack(layers),
+        "final_norm": {"scale": g("model.norm.weight")},
+    }
+    if not cfg.tie_word_embeddings:
+        head = state.get("lm_head.weight", state["model.embed_tokens.weight"])
+        params["lm_head"] = {"w": _t(np.asarray(head))}
+    return params
+
+
+def native_to_hf_llama(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
+    """Native param pytree -> HF Llama state_dict (numpy)."""
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    if "lm_head" in params:
+        out["lm_head.weight"] = _t(params["lm_head"]["w"])
+    for i in range(cfg.num_layers):
+        l = _unstack(params["layers"], i)
+        pre = f"model.layers.{i}."
+        qkv = l["attn"]["qkv"]["w"]  # [H, (nh+2kv)d]
+        q, k, v = np.split(qkv, [nh * d, (nh + nkv) * d], axis=1)
+        out[pre + "self_attn.q_proj.weight"] = _t(q)
+        out[pre + "self_attn.k_proj.weight"] = _t(k)
+        out[pre + "self_attn.v_proj.weight"] = _t(v)
+        out[pre + "self_attn.o_proj.weight"] = _t(l["attn"]["o"]["w"])
+        gate, up = np.split(l["mlp"]["gate_up"]["w"], 2, axis=1)
+        out[pre + "mlp.gate_proj.weight"] = _t(gate)
+        out[pre + "mlp.up_proj.weight"] = _t(up)
+        out[pre + "mlp.down_proj.weight"] = _t(l["mlp"]["down"]["w"])
+        out[pre + "input_layernorm.weight"] = l["input_norm"]["scale"]
+        out[pre + "post_attention_layernorm.weight"] = l["post_attn_norm"]["scale"]
+    return out
+
+
+def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
+    """HF Mixtral state_dict -> native pytree (fused expert stacking,
+    the reference's ``hf_nxdt_mixtral_ckpt_converter.py:40-60`` role)."""
+    lc, e = cfg.llama, cfg.moe.num_experts
+    g = lambda name: np.asarray(state[name])
+    layers = []
+    for i in range(lc.num_layers):
+        pre = f"model.layers.{i}."
+        qkv = np.concatenate(
+            [_t(g(pre + "self_attn.q_proj.weight")),
+             _t(g(pre + "self_attn.k_proj.weight")),
+             _t(g(pre + "self_attn.v_proj.weight"))], axis=1,
+        )
+        gate_up = np.stack([
+            np.concatenate(
+                [_t(g(pre + f"block_sparse_moe.experts.{j}.w1.weight")),
+                 _t(g(pre + f"block_sparse_moe.experts.{j}.w3.weight"))], axis=1)
+            for j in range(e)
+        ])  # [E, H, 2F]
+        down = np.stack([
+            _t(g(pre + f"block_sparse_moe.experts.{j}.w2.weight")) for j in range(e)
+        ])  # [E, F, H]
+        layers.append({
+            "input_norm": {"scale": g(pre + "input_layernorm.weight")},
+            "post_attn_norm": {"scale": g(pre + "post_attention_layernorm.weight")},
+            "attn": {"qkv": {"w": qkv}, "o": {"w": _t(g(pre + "self_attn.o_proj.weight"))}},
+            "mlp": {
+                "router": {"w": _t(g(pre + "block_sparse_moe.gate.weight"))},
+                "experts": {"gate_up": gate_up, "down": down},
+            },
+        })
+    params: dict[str, Any] = {
+        "embed": {"embedding": g("model.embed_tokens.weight")},
+        "layers": _stack(layers),
+        "final_norm": {"scale": g("model.norm.weight")},
+    }
+    if not lc.tie_word_embeddings:
+        params["lm_head"] = {"w": _t(g("lm_head.weight"))}
+    return params
+
+
+def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Load an HF checkpoint dir/file (safetensors or torch .bin) as numpy."""
+    from pathlib import Path
+
+    p = Path(path)
+    files: list[Path]
+    if p.is_dir():
+        files = sorted(p.glob("*.safetensors")) or sorted(p.glob("pytorch_model*.bin"))
+        if not files:
+            raise FileNotFoundError(f"no safetensors/bin files under {p}")
+    else:
+        files = [p]
+    state: dict[str, np.ndarray] = {}
+    for f in files:
+        if f.suffix == ".safetensors":
+            from safetensors.numpy import load_file
+
+            state.update(load_file(str(f)))
+        else:
+            import torch
+
+            sd = torch.load(str(f), map_location="cpu", weights_only=True)
+            state.update({k: v.numpy() for k, v in sd.items()})
+    return state

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Training CLI — the L0 launch layer.
+
+Replaces the reference's ``train.sh`` + ``training_orchestrator.py`` (torchrun +
+Hydra + env-var projection, reference ``examples/train.sh:1-29``,
+``training_orchestrator.py:25-149``) with one entry point:
+
+    python examples/train.py --config examples/conf/hf_llama3_8B_config.yaml \
+        [--set trainer.max_steps=100] [--compile-only]
+
+- ``--set a.b.c=v`` dotted overrides (the Hydra override surface);
+- ``--compile-only`` lowers + compiles the train step and exits — the
+  ``COMPILE=1`` / ``neuron_parallel_compile`` AOT-warmup analogue
+  (``train.sh:19-22``), populating the persistent XLA compilation cache;
+- ``TRAIN_ITERS`` env var overrides ``trainer.max_steps`` (the reference's
+  test hook, ``training_orchestrator.py:48-58``);
+- multi-host: call ``jax.distributed.initialize()`` automatically when the
+  cluster env provides coordination (TPU pods auto-detect).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+logger = logging.getLogger("nxdt.train")
+
+
+def parse_overrides(pairs: list[str]) -> dict:
+    out = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"override must be key.path=value, got {p!r}")
+        k, _, v = p.partition("=")
+        try:
+            import yaml
+
+            out[k] = yaml.safe_load(v)
+        except Exception:
+            out[k] = v
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True, help="YAML config (reference schema)")
+    ap.add_argument("--set", dest="overrides", action="append", default=[],
+                    metavar="KEY=VAL", help="dotted config override")
+    ap.add_argument("--compile-only", action="store_true",
+                    help="AOT-compile the train step and exit (COMPILE=1 analogue)")
+    ap.add_argument("--compilation-cache", default=os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/nxdt_xla_cache"),
+        help="persistent XLA compilation cache dir")
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="force a JAX platform (use cpu for off-hardware smoke "
+                         "runs; set BEFORE backend init, overriding any "
+                         "site-level TPU plugin registration)")
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.compilation_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compilation_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # multi-host init when a cluster environment is detectable
+    if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+    overrides = parse_overrides(args.overrides)
+    if os.environ.get("TRAIN_ITERS"):  # reference test hook
+        overrides["trainer.max_steps"] = int(os.environ["TRAIN_ITERS"])
+    cfg = load_config(args.config, overrides)
+
+    trainer = Trainer.from_config(cfg, enable_checkpointing=not args.compile_only)
+
+    if args.compile_only:
+        import jax.numpy as jnp
+        import numpy as np
+
+        batch = next(trainer.data_module.sharded_batches(trainer.mesh))
+        lowered = trainer.train_step.lower(
+            trainer.params, trainer.opt_state, batch, jax.random.PRNGKey(0)
+        )
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis() or {}
+        logger.info("compile-only: train step compiled; flops=%s bytes=%s",
+                    cost.get("flops"), cost.get("bytes accessed"))
+        return
+
+    metrics = trainer.fit()
+    logger.info("done: %s", {k: round(v, 4) for k, v in metrics.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,8 @@ class ExpManager:
         log_every_n_steps: int = 10,
         global_batch_size: int = 1,
         resume_if_exists: bool = False,
+        profile_start_step: int = 0,  # 0 = profiling off
+        profile_num_steps: int = 3,
     ):
         base = Path(exp_dir) / name
         if version is None:
@@ -58,6 +60,10 @@ class ExpManager:
         self._last_step_time: Optional[float] = None
         self._metrics_file = self.log_dir / "metrics.jsonl"
 
+        self.profile_start_step = profile_start_step
+        self.profile_num_steps = profile_num_steps
+        self._profiling = False
+
         self._tb = None
         if create_tensorboard_logger:
             try:
@@ -81,7 +87,25 @@ class ExpManager:
             ),
             global_batch_size=global_batch_size,
             resume_if_exists=bool(em.get("resume_if_exists", False)),
+            profile_start_step=int(em.get("profile_start_step", 0) or 0),
+            profile_num_steps=int(em.get("profile_num_steps", 3)),
         )
+
+    # -- profiling (jax.profiler -> TensorBoard profile plugin; the TPU-native
+    # replacement for neuron-top/neuron-monitor, SURVEY.md §5.1) --------------
+
+    def maybe_profile(self, step: int) -> None:
+        """Start/stop a ``jax.profiler`` trace around the configured window."""
+        if not self.profile_start_step:
+            return
+        import jax
+
+        if step == self.profile_start_step and not self._profiling:
+            jax.profiler.start_trace(str(self.log_dir / "profile"))
+            self._profiling = True
+        elif self._profiling and step >= self.profile_start_step + self.profile_num_steps:
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     # -- per-step hooks -----------------------------------------------------
 
@@ -113,6 +137,11 @@ class ExpManager:
             f.write(json.dumps({"step": step, **flat}) + "\n")
 
     def close(self) -> None:
+        if self._profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
         if self._tb is not None:
             self._tb.flush()
             self._tb.close()

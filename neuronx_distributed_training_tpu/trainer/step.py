@@ -53,6 +53,7 @@ def make_train_step(
     *,
     num_microbatches: int = 1,
     log_param_norm: bool = False,
+    trainable_mask: Any = None,  # peft.lora.trainable_mask for LoRA freeze
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``."""
@@ -91,7 +92,8 @@ def make_train_step(
 
         lr = lr_schedule(opt_state["step"])
         new_params, new_opt_state, opt_metrics = adamw_update(
-            params, grads, opt_state, lr, opt_cfg, policy
+            params, grads, opt_state, lr, opt_cfg, policy,
+            trainable_mask=trainable_mask,
         )
         metrics = {
             "loss": loss,

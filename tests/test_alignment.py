@@ -1,0 +1,164 @@
+"""LoRA (inject/freeze/merge), DPO (losses + reference pass), ORPO."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_training_tpu.alignment import (
+    compute_reference_logprobs,
+    dpo_loss,
+    orpo_loss,
+    sequence_logprobs,
+)
+from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.optim.adamw import AdamWConfig, adamw_update, init_opt_state
+from neuronx_distributed_training_tpu.peft import (
+    LoraConfig,
+    add_lora,
+    lora_param_specs,
+    merge_lora,
+    trainable_mask,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+FP32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                   softmax_dtype=jnp.float32)
+TINY = llama.LlamaConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+    activations_checkpoint_granularity=None,
+)
+
+
+class TestLora:
+    def test_inject_preserves_forward(self):
+        """Zero-init B => LoRA model == base model at t=0."""
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+        batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)}
+        base_logits, _ = llama.forward(params, batch, TINY, FP32)
+        lparams = add_lora(params, LoraConfig(rank=4), jax.random.PRNGKey(2))
+        lora_logits, _ = llama.forward(lparams, batch, TINY, FP32)
+        np.testing.assert_allclose(np.asarray(base_logits), np.asarray(lora_logits),
+                                   atol=1e-6)
+        # adapters exist on targeted modules, stacked over layers
+        assert lparams["layers"]["attn"]["qkv"]["lora_a"].shape == (2, 32, 4)
+
+    def test_trainable_mask_freezes_base(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+        lparams = add_lora(params, LoraConfig(rank=4), jax.random.PRNGKey(2))
+        mask = trainable_mask(lparams)
+        assert mask["layers"]["attn"]["qkv"]["lora_a"] == 1.0
+        assert mask["layers"]["attn"]["qkv"]["w"] == 0.0
+        assert mask["embed"]["embedding"] == 0.0
+
+    def test_frozen_params_do_not_move(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+        lparams = add_lora(params, LoraConfig(rank=4), jax.random.PRNGKey(2))
+        batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)}
+        batch["labels"] = batch["input_ids"]
+
+        def loss_fn(p):
+            return llama.forward(p, batch, TINY, FP32)[0]
+
+        grads = jax.grad(loss_fn)(lparams)
+        opt = init_opt_state(lparams, FP32)
+        mask = trainable_mask(lparams)
+        new_params, _, _ = adamw_update(
+            lparams, grads, opt, 1e-2, AdamWConfig(), FP32, trainable_mask=mask
+        )
+        np.testing.assert_array_equal(
+            np.asarray(new_params["layers"]["attn"]["qkv"]["w"]),
+            np.asarray(lparams["layers"]["attn"]["qkv"]["w"]),
+        )
+        # adapters DO move
+        assert not np.allclose(
+            np.asarray(new_params["layers"]["attn"]["qkv"]["lora_b"]),
+            np.asarray(lparams["layers"]["attn"]["qkv"]["lora_b"]),
+        )
+
+    def test_merge_matches_adapter_forward(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+        lparams = add_lora(params, LoraConfig(rank=4, alpha=8), jax.random.PRNGKey(2))
+        # give B nonzero values so the adapter actually does something
+        lparams["layers"]["attn"]["qkv"]["lora_b"] = (
+            0.01 * jax.random.normal(jax.random.PRNGKey(3),
+                                     lparams["layers"]["attn"]["qkv"]["lora_b"].shape)
+        )
+        batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)}
+        adapter_logits, _ = llama.forward(lparams, batch, TINY, FP32)
+        merged = merge_lora(lparams)
+        merged_logits, _ = llama.forward(merged, batch, TINY, FP32)
+        np.testing.assert_allclose(np.asarray(adapter_logits),
+                                   np.asarray(merged_logits), atol=1e-5)
+        assert "lora_a" not in merged["layers"]["attn"]["qkv"]
+
+    def test_lora_specs_follow_base_layout(self):
+        specs = llama.param_specs(TINY)
+        lspecs = lora_param_specs(specs, LoraConfig(rank=4))
+        qkv = lspecs["layers"]["attn"]["qkv"]
+        assert qkv["lora_a"] == P(None, None, None)
+        assert qkv["lora_b"] == P(None, None, "model")  # column layout preserved
+        o = lspecs["layers"]["attn"]["o"]
+        assert o["lora_a"] == P(None, "model", None)  # row layout preserved
+        assert o["lora_b"] == P(None, None, None)
+
+
+class TestDPO:
+    def test_sequence_logprobs_masking(self):
+        logits = jnp.zeros((1, 4, 8))  # uniform -> log p = -log 8 per token
+        labels = jnp.array([[1, 2, 3, 4]])
+        mask = jnp.array([[0, 0, 1, 1]])
+        lp = sequence_logprobs(logits, labels, mask)
+        # shift drops position 0; mask keeps labels at shifted positions 1,2
+        np.testing.assert_allclose(float(lp[0]), -2 * np.log(8), rtol=1e-5)
+
+    def test_dpo_loss_prefers_chosen(self):
+        b = jnp.array([0.0, 0.0])
+        loss_good, m_good = dpo_loss(b + 2.0, b - 2.0, b, b, beta=0.5)
+        loss_bad, m_bad = dpo_loss(b - 2.0, b + 2.0, b, b, beta=0.5)
+        assert float(loss_good) < float(loss_bad)
+        assert float(m_good["reward_accuracy"]) == 1.0
+        assert float(m_bad["reward_accuracy"]) == 0.0
+
+    def test_reference_pass_and_loss_fn(self):
+        params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
+
+        def fwd(p, batch):
+            logits, _ = llama.forward(p, batch, TINY, FP32)
+            return logits
+
+        key = jax.random.PRNGKey(1)
+        mk = lambda k: jax.random.randint(k, (2, 16), 0, 64)
+        batches = [
+            {
+                "chosen_input_ids": mk(jax.random.fold_in(key, i)),
+                "rejected_input_ids": mk(jax.random.fold_in(key, 100 + i)),
+            }
+            for i in range(2)
+        ]
+        cols = compute_reference_logprobs(params, batches, fwd)
+        assert cols["reference_chosen_logps"].shape == (4,)
+        assert np.all(np.isfinite(cols["reference_chosen_logps"]))
+
+        # policy == reference at t=0 -> logits term 0 -> loss = -logsigmoid(0)
+        batch = dict(batches[0])
+        batch["reference_chosen_logps"] = jnp.asarray(cols["reference_chosen_logps"][:2])
+        batch["reference_rejected_logps"] = jnp.asarray(cols["reference_rejected_logps"][:2])
+        loss_fn = make_dpo_loss_fn(fwd, beta=0.1)
+        loss, metrics = loss_fn(params, batch, None)
+        np.testing.assert_allclose(float(loss), -np.log(0.5), rtol=1e-4)
+        assert float(metrics["reward_margin"]) == pytest.approx(0.0, abs=1e-5)
+
+
+class TestORPO:
+    def test_orpo_prefers_chosen(self):
+        chosen = jnp.array([-0.5, -0.5])
+        rejected = jnp.array([-3.0, -3.0])
+        nll = jnp.asarray(0.5)
+        loss_good, m = orpo_loss(chosen, rejected, nll, beta=0.5)
+        loss_bad, _ = orpo_loss(rejected, chosen, nll, beta=0.5)
+        assert float(loss_good) < float(loss_bad)
+        assert float(m["orpo_log_odds"]) > 0

@@ -1,0 +1,109 @@
+"""Checkpoint converters: HF <-> native round-trip + forward parity vs HF transformers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_training_tpu.models import llama, mixtral
+from neuronx_distributed_training_tpu.ops import moe as moe_ops
+from neuronx_distributed_training_tpu.tools.convert import (
+    hf_llama_to_native,
+    hf_mixtral_to_native,
+    native_to_hf_llama,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+FP32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                   softmax_dtype=jnp.float32)
+
+CFG = llama.LlamaConfig(
+    vocab_size=96, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+    activations_checkpoint_granularity=None,
+)
+
+
+def tree_equal(a, b, path=""):
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: {set(a)} != {set(b)}"
+        for k in a:
+            tree_equal(a[k], b[k], f"{path}/{k}")
+    else:
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                                   err_msg=path)
+
+
+class TestLlamaRoundTrip:
+    def test_native_hf_native(self):
+        params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+        hf = native_to_hf_llama(params, CFG)
+        back = hf_llama_to_native(hf, CFG)
+        tree_equal(jax.tree_util.tree_map(np.asarray, params), back)
+
+    def test_forward_parity_with_hf_transformers(self):
+        """Converted weights must produce the same logits as HF transformers."""
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+        hf_cfg = HFConfig(
+            vocab_size=CFG.vocab_size, hidden_size=CFG.hidden_size,
+            intermediate_size=CFG.intermediate_size, num_hidden_layers=CFG.num_layers,
+            num_attention_heads=CFG.num_attention_heads,
+            num_key_value_heads=CFG.kv_heads,
+            max_position_embeddings=CFG.max_position_embeddings,
+            rope_theta=CFG.rope_theta, rms_norm_eps=CFG.rms_norm_eps,
+            attention_bias=False, tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        hf_model = LlamaForCausalLM(hf_cfg).eval()
+        state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+        params = hf_llama_to_native(state, CFG)
+
+        ids = np.arange(16, dtype=np.int64)[None, :] % CFG.vocab_size
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+        our_logits, _ = llama.forward(
+            jax.tree_util.tree_map(jnp.asarray, params),
+            {"input_ids": jnp.asarray(ids, jnp.int32)}, CFG, FP32,
+        )
+        np.testing.assert_allclose(np.asarray(our_logits), hf_logits,
+                                   atol=2e-4, rtol=1e-3)
+
+
+class TestMixtralConvert:
+    def test_expert_stacking_shapes(self):
+        xcfg = mixtral.MixtralConfig(
+            llama=CFG, moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True)
+        )
+        params = mixtral.init_params(jax.random.PRNGKey(0), xcfg, FP32)
+        # fabricate an HF-style state dict from the native one, then convert
+        state = {}
+        state["model.embed_tokens.weight"] = np.asarray(params["embed"]["embedding"])
+        state["model.norm.weight"] = np.asarray(params["final_norm"]["scale"])
+        state["lm_head.weight"] = np.asarray(params["lm_head"]["w"]).T
+        nh, nkv, d = CFG.num_attention_heads, CFG.kv_heads, CFG.head_size
+        F = CFG.intermediate_size
+        for i in range(CFG.num_layers):
+            pre = f"model.layers.{i}."
+            qkv = np.asarray(params["layers"]["attn"]["qkv"]["w"][i])
+            q, k, v = np.split(qkv, [nh * d, (nh + nkv) * d], axis=1)
+            state[pre + "self_attn.q_proj.weight"] = q.T
+            state[pre + "self_attn.k_proj.weight"] = k.T
+            state[pre + "self_attn.v_proj.weight"] = v.T
+            state[pre + "self_attn.o_proj.weight"] = np.asarray(
+                params["layers"]["attn"]["o"]["w"][i]).T
+            state[pre + "input_layernorm.weight"] = np.asarray(
+                params["layers"]["input_norm"]["scale"][i])
+            state[pre + "post_attention_layernorm.weight"] = np.asarray(
+                params["layers"]["post_attn_norm"]["scale"][i])
+            state[pre + "block_sparse_moe.gate.weight"] = np.asarray(
+                params["layers"]["mlp"]["router"]["w"][i]).T
+            for j in range(4):
+                gu = np.asarray(params["layers"]["mlp"]["experts"]["gate_up"][i, j])
+                state[pre + f"block_sparse_moe.experts.{j}.w1.weight"] = gu[:, :F].T
+                state[pre + f"block_sparse_moe.experts.{j}.w3.weight"] = gu[:, F:].T
+                state[pre + f"block_sparse_moe.experts.{j}.w2.weight"] = np.asarray(
+                    params["layers"]["mlp"]["experts"]["down"][i, j]).T
+        back = hf_mixtral_to_native(state, xcfg)
+        tree_equal(jax.tree_util.tree_map(np.asarray, params), back)

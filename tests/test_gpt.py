@@ -1,0 +1,112 @@
+"""Megatron-family GPT: config surface, forward variants, TP parity, dropout."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.models import gpt
+from neuronx_distributed_training_tpu.ops import moe as moe_ops
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+FP32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                   softmax_dtype=jnp.float32)
+
+BASE = dict(
+    vocab_size=96, hidden_size=32, num_layers=2, num_attention_heads=4,
+    max_position_embeddings=32, activations_checkpoint_granularity=None,
+)
+
+
+def _batch(key, b=2, s=16, vocab=96):
+    ids = jax.random.randint(key, (b, s), 0, vocab)
+    return {"input_ids": ids, "labels": ids}
+
+
+class TestVariants:
+    @pytest.mark.parametrize("kwargs", [
+        dict(),  # gelu + layernorm + learned bias + rope + tied
+        dict(activation="swiglu", normalization="rmsnorm", bias=False),
+        dict(position_embedding_type="learned_absolute"),
+        dict(num_query_groups=2),
+        dict(num_query_groups=1),  # MQA
+        dict(rotary_percentage=0.5),
+        dict(share_embeddings_and_output_weights=False),
+        dict(sliding_window=8),
+    ])
+    def test_forward_finite(self, kwargs):
+        cfg = gpt.GPTConfig(**{**BASE, **kwargs})
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        loss, _ = gpt.forward(params, _batch(jax.random.PRNGKey(1)), cfg, FP32)
+        assert np.isfinite(float(loss))
+        assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.5
+
+    def test_moe_gpt(self):
+        cfg = gpt.GPTConfig(**BASE, moe=moe_ops.MoEConfig(
+            num_experts=4, top_k=1, router_type="sinkhorn", dropless=True))
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        loss, aux = gpt.forward(params, _batch(jax.random.PRNGKey(1)), cfg, FP32)
+        assert np.isfinite(float(loss))
+        assert "router_aux_loss" in aux
+
+    def test_dropout_deterministic_given_rng(self):
+        cfg = gpt.GPTConfig(**BASE, hidden_dropout=0.2, embedding_dropout=0.1)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        batch = _batch(jax.random.PRNGKey(1))
+        l1, _ = gpt.forward(params, batch, cfg, FP32, rng=jax.random.PRNGKey(7))
+        l2, _ = gpt.forward(params, batch, cfg, FP32, rng=jax.random.PRNGKey(7))
+        l3, _ = gpt.forward(params, batch, cfg, FP32, rng=jax.random.PRNGKey(8))
+        assert float(l1) == float(l2)
+        assert float(l1) != float(l3)
+        # eval mode (no rng) = no dropout
+        le, _ = gpt.forward(params, batch, cfg, FP32)
+        assert float(le) != float(l1)
+
+    def test_from_config_megatron_schema(self):
+        cfg = gpt.GPTConfig.from_config({
+            "vocab_size": 1000, "hidden_size": 64, "num_layers": 4,
+            "num_attention_heads": 8, "num_query_groups": 2,
+            "activation": "swiglu", "normalization": "rmsnorm",
+            "position_embedding_type": "rope", "bias": False,
+            "num_moe_experts": 8,
+        }, {"sequence_parallel": True, "tensor_model_parallel_size": 2})
+        assert cfg.kv_heads == 2
+        assert cfg.is_glu
+        assert cfg.moe is not None and cfg.moe.num_experts == 8
+        assert cfg.sequence_parallel
+
+
+class TestShardedGPT:
+    def test_tp_parity(self, devices8):
+        cfg = gpt.GPTConfig(**BASE, num_query_groups=2, activation="swiglu")
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        batch = _batch(jax.random.PRNGKey(1), b=4)  # divisible by the dp axis (4)
+
+        def loss_fn(p, b):
+            return gpt.forward(p, b, cfg, FP32)[0]
+
+        ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params, batch)
+        mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))
+        specs = gpt.param_specs(cfg)
+        ns = functools.partial(NamedSharding, mesh)
+        sh_params = jax.device_put(
+            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        sh_batch = jax.device_put(batch, ns(P(("data", "expert"))))
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(jax.value_and_grad(loss_fn))(sh_params, sh_batch)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads["embed"]["embedding"]),
+            np.asarray(ref_grads["embed"]["embedding"]), rtol=1e-3, atol=1e-5,
+        )
+
+    def test_pipeline_specs_exist(self):
+        cfg = gpt.GPTConfig(**BASE)
+        specs = gpt.param_specs(cfg, pipeline=True)
+        assert specs["layers"]["attn"]["qkv"]["w"][0] == "pipe"
