@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Checkpoint converter CLI: HF <-> native Orbax checkpoints.
+
+The reference's converter surface (``checkpoint_converter_scripts/
+checkpoint_converter.py:1-53``: HF full-state <-> sharded, both directions,
+Llama + Mixtral):
+
+    python examples/checkpoint_converter.py \
+        --model llama --direction hf2native \
+        --config examples/conf/hf_llama3_8B_config.yaml \
+        --input /path/to/hf_checkpoint_dir --output /path/to/native_ckpt
+
+native2hf writes a ``model.safetensors`` (or .npz fallback) HF state dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", choices=["llama", "mixtral"], default="llama")
+    ap.add_argument("--direction", choices=["hf2native", "native2hf"], required=True)
+    ap.add_argument("--config", required=True, help="YAML config (reference schema)")
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--step", type=int, default=0,
+                    help="checkpoint step number to write/read (native side)")
+    args = ap.parse_args()
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.models import llama as llama_mod
+    from neuronx_distributed_training_tpu.tools import convert
+
+    cfg_yaml = load_config(args.config)
+    model_block = dict(cfg_yaml.get("model", {}) or {})
+    ds_block = dict(cfg_yaml.get("distributed_strategy", {}) or {})
+
+    if args.model == "llama":
+        cfg = llama_mod.LlamaConfig.from_config(model_block, ds_block)
+        to_native = lambda sd: convert.hf_llama_to_native(sd, cfg)
+        to_hf = lambda p: convert.native_to_hf_llama(p, cfg)
+    else:
+        from neuronx_distributed_training_tpu.models import mixtral as mixtral_mod
+
+        cfg = mixtral_mod.MixtralConfig.from_config(model_block, ds_block)
+        to_native = lambda sd: convert.hf_mixtral_to_native(sd, cfg)
+        to_hf = None  # native->hf mixtral: not yet implemented
+
+    out = Path(args.output)
+    if args.direction == "hf2native":
+        state = convert.load_torch_state_dict(args.input)
+        params = to_native(state)
+        with ocp.CheckpointManager(out.absolute()) as mgr:
+            mgr.save(args.step, args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params)))
+            mgr.wait_until_finished()
+        print(f"wrote native checkpoint: {out}/{args.step}/params")
+    else:
+        if to_hf is None:
+            raise SystemExit("native2hf for mixtral not yet implemented")
+        with ocp.CheckpointManager(Path(args.input).absolute()) as mgr:
+            step = args.step or mgr.latest_step()
+            restored = mgr.restore(step, args=ocp.args.Composite(
+                params=ocp.args.StandardRestore()))
+        sd = to_hf(restored["params"])
+        out.mkdir(parents=True, exist_ok=True)
+        try:
+            from safetensors.numpy import save_file
+
+            save_file(sd, str(out / "model.safetensors"))
+            print(f"wrote {out}/model.safetensors ({len(sd)} tensors)")
+        except ImportError:
+            import numpy as np
+
+            np.savez(out / "model.npz", **sd)
+            print(f"wrote {out}/model.npz ({len(sd)} tensors)")
+
+
+if __name__ == "__main__":
+    main()

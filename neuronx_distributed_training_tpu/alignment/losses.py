@@ -83,8 +83,8 @@ def orpo_loss(
     # log odds ratio: log( odds(chosen) / odds(rejected) ),
     # odds(p) = p / (1 - p) computed in log space for stability
     log_odds = (chosen_avg_logps - rejected_avg_logps) - (
-        jnp.log1p(-jnp.exp(jnp.clip(chosen_avg_logps, a_max=-1e-6)))
-        - jnp.log1p(-jnp.exp(jnp.clip(rejected_avg_logps, a_max=-1e-6)))
+        jnp.log1p(-jnp.exp(jnp.clip(chosen_avg_logps, max=-1e-6)))
+        - jnp.log1p(-jnp.exp(jnp.clip(rejected_avg_logps, max=-1e-6)))
     )
     ratio_term = -jax.nn.log_sigmoid(log_odds)
     loss = chosen_nll + beta * jnp.mean(ratio_term)

@@ -130,9 +130,15 @@ class GPTDataset:
                 self.indexed.sizes, self.doc_idx, num_samples, seq_length
             )
             self.shuffle_idx = build_shuffle_idx(len(self.sample_idx) - 1, seed)
-            np.save(doc_p, self.doc_idx)
-            np.save(samp_p, self.sample_idx)
-            np.save(shuf_p, self.shuffle_idx)
+            # atomic writes (tmp + rename): another host may be racing on the
+            # same cache dir; a reader must never see a partially-written .npy
+            import os
+
+            for path, arr in ((doc_p, self.doc_idx), (samp_p, self.sample_idx),
+                              (shuf_p, self.shuffle_idx)):
+                tmp = path.with_suffix(f".tmp{os.getpid()}.npy")
+                np.save(tmp, arr)
+                os.replace(tmp, path)
 
     def __len__(self) -> int:
         return len(self.shuffle_idx)

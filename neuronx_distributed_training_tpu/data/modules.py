@@ -1,0 +1,197 @@
+"""Higher-level DataModules: Megatron pretraining + SFT/DPO alignment.
+
+Counterparts of the reference's ``MegatronDataModule``
+(``data/megatron/data_module.py``: tokenizer build, mmap GPT dataset build with
+train/valid/test sample counts from ``max_steps x gbs``, per-DP samplers) and
+``ModelAlignmentDataModule`` (``model_alignment_data_module.py``: jsonl/arrow
+load, prompt templates, per-algorithm tokenization, packing/padding dataloader
+build).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from neuronx_distributed_training_tpu.data.loader import DataModule
+from neuronx_distributed_training_tpu.data.packing import (
+    mask_prompt_labels,
+    pack_sequences,
+    pad_sequences,
+)
+
+
+class MegatronDataModule(DataModule):
+    """Mmap GPT pretraining data (reference ``megatron/data_module.py:89-173``).
+
+    ``num_samples`` defaults to ``max_steps * global_batch_size`` the way the
+    reference sizes its train split (``:89-130``).
+    """
+
+    def __init__(
+        self,
+        path_prefix: str | Path,
+        seq_length: int,
+        global_batch_size: int,
+        *,
+        max_steps: int = 1000,
+        num_samples: Optional[int] = None,
+        seed: int = 1234,
+        **kw: Any,
+    ):
+        from neuronx_distributed_training_tpu.data.megatron import GPTDataset
+
+        n = num_samples or max_steps * global_batch_size
+        self.dataset = GPTDataset(path_prefix, seq_length, n, seed=seed)
+        super().__init__(len(self.dataset), global_batch_size,
+                         input_names=("input_ids", "labels", "loss_mask"), **kw)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        rows = [self.dataset[int(i)] for i in idx]
+        return {
+            "input_ids": np.stack([r["input_ids"] for r in rows]),
+            # GPTDataset pre-shifts labels; model must be called with
+            # shift_labels=False for exact parity, or labels re-derived.
+            "labels": np.stack([r["labels"] for r in rows]),
+        }
+
+
+def load_alignment_records(path: str | Path) -> list[dict[str, Any]]:
+    """Load jsonl / json / arrow-dir alignment data
+    (reference ``model_alignment_data_module.py:67-92``)."""
+    p = Path(path)
+    if p.is_dir():
+        import datasets
+
+        return [dict(r) for r in datasets.load_from_disk(str(p))]
+    if p.suffix == ".jsonl":
+        return [json.loads(line) for line in p.read_text().splitlines() if line.strip()]
+    if p.suffix == ".json":
+        data = json.loads(p.read_text())
+        return data if isinstance(data, list) else data["data"]
+    raise ValueError(f"unsupported alignment data format: {p}")
+
+
+class SFTDataModule(DataModule):
+    """SFT data: tokenize prompt/completion pairs, mask prompt labels, then
+    greedy-pack (``packing: true``) or pad to fixed length
+    (reference ``model_alignment_data_module.py:148-160, 186-224``).
+
+    Records need ``input``/``output`` keys (or ``prompt``/``completion``).
+    ``tokenizer`` is any callable ``str -> list[int]`` or an HF tokenizer.
+    """
+
+    def __init__(
+        self,
+        records: Sequence[dict[str, Any]] | str | Path,
+        tokenizer: Any,
+        seq_length: int,
+        global_batch_size: int,
+        *,
+        packing: bool = True,
+        bos_id: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        pad_id: int = 0,
+        **kw: Any,
+    ):
+        if isinstance(records, (str, Path)):
+            records = load_alignment_records(records)
+        encode = tokenizer.encode if hasattr(tokenizer, "encode") else tokenizer
+        if eos_id is None:
+            eos_id = getattr(tokenizer, "eos_token_id", 0) or 0
+        if bos_id is None:
+            bos_id = getattr(tokenizer, "bos_token_id", None)
+
+        ids_list, lbl_list = [], []
+        for r in records:
+            src = r.get("input", r.get("prompt", ""))
+            dst = r.get("output", r.get("completion", ""))
+            # bos+src / dst+eos split (reference :148-160)
+            prompt_toks = ([bos_id] if bos_id is not None else []) + list(encode(src))
+            resp_toks = list(encode(dst))
+            ids, lbl = mask_prompt_labels(prompt_toks, resp_toks)
+            ids_list.append(ids)
+            lbl_list.append(lbl)
+
+        if packing:
+            self.arrays = pack_sequences(
+                ids_list, seq_length, eos_id, label_lists=lbl_list, pad_id=pad_id
+            )
+        else:
+            padded = pad_sequences(
+                ids_list, seq_length, pad_id, label_lists=lbl_list
+            )
+            self.arrays = {k: padded[k] for k in ("input_ids", "labels", "loss_mask")}
+        n = len(self.arrays["input_ids"])
+        if n < global_batch_size:
+            raise ValueError(
+                f"SFT dataset too small: {n} packed rows < global_batch_size "
+                f"{global_batch_size}"
+            )
+        super().__init__(n, global_batch_size, shuffle=kw.pop("shuffle", True), **kw)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class DPODataModule(DataModule):
+    """DPO/ORPO preference data: chosen/rejected pairs, prompt left-pad
+    convention (reference ``PaddedDPODataset``, ``PaddedDataset.py:60-103``).
+
+    Records need ``prompt``, ``chosen``, ``rejected`` keys.  After construction,
+    call ``attach_reference_logprobs`` with the pre-fit pass output
+    (``alignment.dpo.compute_reference_logprobs``).
+    """
+
+    def __init__(
+        self,
+        records: Sequence[dict[str, Any]] | str | Path,
+        tokenizer: Any,
+        seq_length: int,
+        global_batch_size: int,
+        *,
+        pad_id: int = 0,
+        **kw: Any,
+    ):
+        if isinstance(records, (str, Path)):
+            records = load_alignment_records(records)
+        encode = tokenizer.encode if hasattr(tokenizer, "encode") else tokenizer
+        eos = getattr(tokenizer, "eos_token_id", 0) or 0
+
+        arrays: dict[str, list] = {}
+        for side in ("chosen", "rejected"):
+            ids_list, lbl_list = [], []
+            for r in records:
+                p_toks = list(encode(r["prompt"]))
+                c_toks = list(encode(r[side])) + [eos]
+                ids, lbl = mask_prompt_labels(p_toks, c_toks)
+                ids_list.append(ids)
+                lbl_list.append(lbl)
+            padded = pad_sequences(ids_list, seq_length, pad_id, label_lists=lbl_list)
+            arrays[f"{side}_input_ids"] = padded["input_ids"]
+            arrays[f"{side}_loss_mask"] = padded["loss_mask"]
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        n = len(records)
+        super().__init__(
+            n, global_batch_size, shuffle=kw.pop("shuffle", True),
+            input_names=tuple(self.arrays), **kw,
+        )
+
+    def attach_reference_logprobs(self, columns: dict[str, np.ndarray]) -> None:
+        """The reference's mid-fit dataset-column append (``base_dpo.py:61-62``)."""
+        for k, v in columns.items():
+            if len(v) != len(self.arrays["chosen_input_ids"]):
+                raise ValueError(f"column {k} length {len(v)} != dataset size")
+            self.arrays[k] = np.asarray(v, np.float32)
+        self.input_names = tuple(self.arrays)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+    def global_batches(self):
+        # DPO batches bypass causal-LM label derivation
+        for idx in self.sampler:
+            yield self.fetch_rows(idx)

@@ -1,0 +1,62 @@
+"""Autoregressive generation (greedy / temperature sampling).
+
+Backs the SFT evaluation harness the way the reference's traced-inference
+``LlamaRunner`` backs ``sft_evaluation/evaluate.py`` (reference
+``examples/sft_evaluation/models/nxd_llama.py``).  XLA-friendly: one fixed
+``[batch, max_len]`` token buffer, ``lax.fori_loop`` over positions, full-prefix
+forward per step (static shapes; a KV-cache decode path is a later perf
+optimization — eval harness workloads are small).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# logits_of: (params, input_ids [b, L]) -> logits [b, L, vocab]
+LogitsFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def generate(
+    params: Any,
+    prompt_ids: jax.Array,  # [b, prompt_len] left-padded with pad_id
+    prompt_lens: jax.Array,  # [b] true prompt lengths
+    logits_of: LogitsFn,
+    *,
+    max_new_tokens: int,
+    eos_id: int,
+    pad_id: int = 0,
+    temperature: float = 0.0,  # 0 = greedy
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate up to ``max_new_tokens``; returns ``[b, prompt_len + max_new]``.
+
+    Positions after a generated EOS are filled with ``pad_id``.
+    """
+    b, plen = prompt_ids.shape
+    total = plen + max_new_tokens
+    buf = jnp.full((b, total), pad_id, dtype=prompt_ids.dtype)
+    buf = buf.at[:, :plen].set(prompt_ids)
+    done0 = jnp.zeros((b,), bool)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def step(i, carry):
+        buf, done, key = carry
+        pos = plen + i  # next position to fill
+        logits = logits_of(params, buf)  # [b, total, vocab]
+        next_logits = logits[:, pos - 1, :]
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(next_logits, axis=-1)
+        nxt = nxt.astype(buf.dtype)
+        nxt = jnp.where(done, jnp.asarray(pad_id, buf.dtype), nxt)
+        buf = buf.at[:, pos].set(nxt)
+        done = done | (nxt == eos_id)
+        return buf, done, key
+
+    buf, _, _ = jax.lax.fori_loop(0, max_new_tokens, step, (buf, done0, key))
+    return buf

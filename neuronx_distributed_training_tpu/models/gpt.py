@@ -300,7 +300,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy):
 def _mlp_block(cfg, lp, x, policy):
     if cfg.moe is not None:
         y, aux = moe_ops.moe_block(lp, x, cfg.moe, compute_dtype=policy.compute_dtype)
-        aux_loss = moe_ops.load_balancing_loss(
+        aux_loss = moe_ops.weighted_router_loss(
             aux["router_logits"], aux["expert_idx"], cfg.moe
         )
         return y, aux_loss
@@ -392,6 +392,7 @@ def forward(
 
     aux: dict[str, Any] = {}
     if cfg.moe is not None:
+        # already coefficient-weighted (weighted_router_loss)
         aux["router_aux_loss"] = aux_sum / cfg.num_layers
     if return_logits:
         aux["logits"] = logits
@@ -403,5 +404,5 @@ def forward(
         logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
     loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)
     if cfg.moe is not None:
-        loss = loss + cfg.moe.router_aux_loss_coef * aux["router_aux_loss"]
+        loss = loss + aux["router_aux_loss"]
     return loss, aux

@@ -125,7 +125,7 @@ def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy):
     hidden, aux = moe_ops.moe_block(
         lp["mlp"], hidden, cfg.moe, compute_dtype=policy.compute_dtype
     )
-    aux_loss = moe_ops.load_balancing_loss(aux["router_logits"], aux["expert_idx"], cfg.moe)
+    aux_loss = moe_ops.weighted_router_loss(aux["router_logits"], aux["expert_idx"], cfg.moe)
     return shd.constrain(residual + hidden, aspec), aux_loss
 
 
@@ -162,6 +162,7 @@ def forward(
     hidden = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
     logits = llama.logits_fn(params, hidden, lc, policy)
 
+    # router_aux_loss is already coefficient-weighted (weighted_router_loss)
     aux: dict[str, Any] = {"router_aux_loss": aux_sum / lc.num_layers}
     if return_logits:
         aux["logits"] = logits
@@ -172,6 +173,6 @@ def forward(
     if shift_labels:
         logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
     lm_loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)
-    loss = lm_loss + cfg.moe.router_aux_loss_coef * aux["router_aux_loss"]
+    loss = lm_loss + aux["router_aux_loss"]
     aux["lm_loss"] = lm_loss
     return loss, aux

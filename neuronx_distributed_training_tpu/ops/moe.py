@@ -140,16 +140,29 @@ def route(router_params, x: jax.Array, cfg: MoEConfig):
 
 def load_balancing_loss(router_logits: jax.Array, idx: jax.Array, cfg: MoEConfig) -> jax.Array:
     """Switch/Mixtral aux loss: E * mean_e(frac_tokens_e * frac_prob_e)
-    (reference ``load_balancing_loss_func``, ``modeling_mixtral.py:872-878``)."""
+    (reference ``load_balancing_loss_func``, ``modeling_mixtral.py:872-878``).
+    Unweighted; combine with coefficients via ``weighted_router_loss``."""
     e = cfg.num_experts
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T, E]
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, k, E]
     frac_tokens = jnp.mean(jnp.sum(onehot, axis=1), axis=0)  # [E]
     frac_probs = jnp.mean(probs, axis=0)  # [E]
-    loss = e * jnp.sum(frac_tokens * frac_probs) / max(cfg.top_k, 1)
+    return e * jnp.sum(frac_tokens * frac_probs) / max(cfg.top_k, 1)
+
+
+def router_z_loss(router_logits: jax.Array) -> jax.Array:
+    """ST-MoE router z-loss: mean(logsumexp(logits)^2) — keeps logits bounded."""
+    z = jax.nn.logsumexp(router_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(z**2)
+
+
+def weighted_router_loss(router_logits: jax.Array, idx: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Per-layer auxiliary loss with coefficients already applied:
+    ``aux_coef * load_balancing + z_coef * z``.  Models add the per-layer mean
+    of this directly to the LM loss (no further scaling)."""
+    loss = cfg.router_aux_loss_coef * load_balancing_loss(router_logits, idx, cfg)
     if cfg.router_z_loss_coef > 0:
-        z = jax.nn.logsumexp(router_logits.astype(jnp.float32), axis=-1)
-        loss = loss + cfg.router_z_loss_coef / max(cfg.router_aux_loss_coef, 1e-9) * jnp.mean(z**2)
+        loss = loss + cfg.router_z_loss_coef * router_z_loss(router_logits)
     return loss
 
 

@@ -50,15 +50,46 @@ StageFn = Callable[[Any, jax.Array, dict], jax.Array]
 LossFn = Callable[[Any, jax.Array, dict], tuple]
 
 
-def stage_layer_slice(num_layers: int, pp: int) -> int:
-    if num_layers % pp != 0:
-        raise ValueError(f"num_layers {num_layers} not divisible by pp {pp}")
-    return num_layers // pp
+def stage_layer_slice(num_layers: int, pp: int, vp: int = 1) -> int:
+    if num_layers % (pp * vp) != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pp*vp = {pp}*{vp}"
+        )
+    return num_layers // (pp * vp)
+
+
+def to_interleaved(layer_stack: Any, pp: int, vp: int) -> Any:
+    """[L, ...] stacked layers -> [vp, pp, Lc, ...] stage-major layout.
+
+    Stage ``s = c*pp + r`` (chunk c on rank r) covers layers
+    ``[s*Lc, (s+1)*Lc)`` — the interleaved assignment of the reference's
+    ``virtual_pipeline_model_parallel_size`` (``base.py:85,155``).  Pure
+    reshape: layer index ``l = (c*pp + r)*Lc + k`` has dims ordered (c, r, k),
+    so the ``pp`` dim can be sharded over ``pipe`` without any transpose.
+    """
+
+    def one(x):
+        L = x.shape[0]
+        lc = stage_layer_slice(L, pp, vp)
+        return x.reshape((vp, pp, lc) + x.shape[1:])
+
+    return jax.tree_util.tree_map(one, layer_stack)
+
+
+def from_interleaved(layer_stack: Any) -> Any:
+    """Inverse of ``to_interleaved``: [vp, pp, Lc, ...] -> [L, ...]."""
+
+    def one(x):
+        vp, pp, lc = x.shape[:3]
+        return x.reshape((vp * pp * lc,) + x.shape[3:])
+
+    return jax.tree_util.tree_map(one, layer_stack)
 
 
 def pipeline_loss(
     params: Any,
-    layer_params: Any,  # stacked [num_layers, ...]; dim 0 sharded over "pipe"
+    layer_params: Any,  # vp==1: [num_layers, ...] dim0 over "pipe";
+                        # vp>1: interleaved [vp, pp, Lc, ...] dim1 over "pipe"
     microbatches: dict[str, jax.Array],  # leaves [num_micro, mb, ...]
     *,
     embed_fn: EmbedFn,
@@ -66,8 +97,15 @@ def pipeline_loss(
     loss_fn: LossFn,
     mesh=None,
     num_microbatches: Optional[int] = None,
+    virtual_pipeline_size: int = 1,
 ) -> jax.Array:
     """Scalar pipeline-parallel loss (mean over microbatches).
+
+    ``virtual_pipeline_size > 1`` runs the interleaved/circular schedule
+    (reference VPP, ``base.py:85,155``): each rank holds ``vp`` non-adjacent
+    layer chunks (pass ``to_interleaved(layers, pp, vp)``), microbatches cycle
+    through the ranks ``vp`` times, and per-rank utilization improves from
+    ``nm/(nm+pp-1)`` to ``nm*vp/(nm*vp+pp-1)``.
 
     Falls back to a plain sequential microbatch loop when pp == 1, so the same
     entry point drives both pipelined and unpipelined configs.
@@ -75,8 +113,20 @@ def pipeline_loss(
     mesh = mesh or shd.active_mesh()
     pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
     nm = num_microbatches or jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    vp = virtual_pipeline_size
+    if vp > 1 and 1 < pp and nm < pp:
+        # chunk c+1 reads the circular store at tick c*nm + m, but the last
+        # rank's chunk-c output is only parked at tick c*nm + m + pp — with
+        # nm < pp the read precedes the write and the loss is silently wrong
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches >= pp "
+            f"(got nm={nm}, pp={pp}, vp={vp})"
+        )
 
     if pp == 1:
+        if vp > 1:
+            layer_params = from_interleaved(layer_params)
+
         def body(acc, mb):
             x = embed_fn(params, mb)
             x = stage_fn(layer_params, x, mb)
@@ -90,17 +140,17 @@ def pipeline_loss(
 
     body = functools.partial(
         _pipeline_body,
-        embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, pp=pp, nm=nm,
+        embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, pp=pp, nm=nm, vp=vp,
     )
     from jax.sharding import PartitionSpec as P
 
+    layer_spec = P(None, PIPE_AXIS) if vp > 1 else P(PIPE_AXIS)
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        # manual over pipe only: layer stack sharded on dim 0; params and
-        # microbatches replicated across pipe (GSPMD still shards them over
-        # data/model inside)
-        in_specs=(P(), P(PIPE_AXIS), P()),
+        # manual over pipe only: params and microbatches replicated across pipe
+        # (GSPMD still shards them over data/model inside)
+        in_specs=(P(), layer_spec, P()),
         out_specs=P(),
         axis_names={PIPE_AXIS},
         check_vma=False,
@@ -109,46 +159,90 @@ def pipeline_loss(
 
 
 def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
-                   loss_fn, pp, nm):
-    """Per-pipe-rank wavefront loop (inside shard_map, manual over "pipe")."""
+                   loss_fn, pp, nm, vp):
+    """Per-pipe-rank circular wavefront loop (inside shard_map, manual "pipe").
+
+    Schedule: rank ``r`` at tick ``t`` works on work-index ``w = t - r`` —
+    microbatch ``m = w mod nm`` of chunk ``c = w // nm``.  Chunk hand-off
+    between chunks rides a per-microbatch circular store on rank 0 (outputs of
+    the last rank come back around the cyclic ring one tick later and wait in
+    ``circ_storage`` until chunk ``c+1``'s slot).  Total ticks
+    ``nm*vp + pp - 1``.  With vp == 1 this is the plain GPipe wavefront.
+    """
     rank = jax.lax.axis_index(PIPE_AXIS)
     is_first = rank == 0
     is_last = rank == pp - 1
 
+    # normalize local layer layout to [vp, Lc, ...]
+    if vp > 1:
+        local_layers = jax.tree_util.tree_map(
+            lambda x: jnp.squeeze(x, axis=1), local_layers
+        )
+    else:
+        local_layers = jax.tree_util.tree_map(lambda x: x[None], local_layers)
+
     mb0 = jax.tree_util.tree_map(lambda x: x[0], microbatches)
-    x0 = embed_fn(params, mb0)  # shape/dtype template for the stream buffer
+    x0 = embed_fn(params, mb0)  # shape/dtype template for the stream buffers
 
     # rematerialize stage activations in backward: only stage inputs are saved
     compute = jax.checkpoint(stage_fn)
 
-    send_perm = [(i, i + 1) for i in range(pp - 1)]  # rank 0 receives zeros
+    cyclic = [(i, (i + 1) % pp) for i in range(pp)]
 
     def tick(carry, t):
-        recv, loss_acc, denom_acc = carry
-        # stage-0 input: microbatch t (clamped; ticks past nm-1 are drain-only)
-        t_in = jnp.clip(t, 0, nm - 1)
-        mb_in = jax.tree_util.tree_map(lambda x: x[t_in], microbatches)
-        fresh = embed_fn(params, mb_in)
-        x = jnp.where(is_first, fresh, recv)
-        y = compute(local_layers, x, mb_in)
+        recv, circ, loss_acc, denom_acc = carry
 
-        # last stage: microbatch t - (pp-1) exits the pipe at this tick
-        t_out = t - (pp - 1)
-        t_out_c = jnp.clip(t_out, 0, nm - 1)
-        mb_out = jax.tree_util.tree_map(lambda x: x[t_out_c], microbatches)
-        loss, denom = loss_fn(params, y, mb_out)
-        valid = jnp.logical_and(is_last, jnp.logical_and(t_out >= 0, t_out < nm))
+        if vp > 1:
+            # rank 0: recv holds last-rank output from tick t-1 (work index
+            # w_back); park it in the circular store for its next chunk
+            w_back = t - 1 - (pp - 1)
+            m_back = jnp.clip(jnp.remainder(w_back, nm), 0, nm - 1)
+            back_valid = jnp.logical_and(w_back >= 0, w_back < nm * (vp - 1))
+            slot = jax.lax.dynamic_index_in_dim(circ, m_back, 0, keepdims=False)
+            circ = jax.lax.dynamic_update_index_in_dim(
+                circ, jnp.where(back_valid, recv, slot), m_back, 0
+            )
+
+        w = t - rank
+        w_c = jnp.clip(w, 0, nm * vp - 1)
+        m = jnp.remainder(w_c, nm)
+        c = w_c // nm
+        mb = jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, m, 0, keepdims=False),
+            microbatches,
+        )
+        fresh = embed_fn(params, mb)
+        if vp > 1:
+            parked = jax.lax.dynamic_index_in_dim(circ, m, 0, keepdims=False)
+            first_in = jnp.where(c == 0, fresh, parked)
+        else:
+            first_in = fresh
+        x = jnp.where(is_first, first_in, recv)
+
+        lp_c = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            local_layers,
+        )
+        y = compute(lp_c, x, mb)
+
+        loss, denom = loss_fn(params, y, mb)
+        valid = jnp.logical_and(
+            jnp.logical_and(is_last, c == vp - 1), jnp.logical_and(w >= 0, w < nm * vp)
+        )
         loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
         denom_acc = denom_acc + jnp.where(valid, denom, 0.0)
 
-        recv = jax.lax.ppermute(y, PIPE_AXIS, send_perm)
-        return (recv, loss_acc, denom_acc), None
+        recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
+        return (recv, circ, loss_acc, denom_acc), None
 
     zeros = jnp.zeros_like(x0)
-    (_, loss_acc, denom_acc), _ = jax.lax.scan(
+    circ0 = (
+        jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1 else jnp.zeros((1, 1), x0.dtype)
+    )
+    (_, _, loss_acc, denom_acc), _ = jax.lax.scan(
         tick,
-        (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-        jnp.arange(nm + pp - 1),
+        (zeros, circ0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        jnp.arange(nm * vp + pp - 1),
     )
     # only the last rank's accumulators are real; psum broadcasts the scalars
     loss_total = jax.lax.psum(loss_acc, PIPE_AXIS)

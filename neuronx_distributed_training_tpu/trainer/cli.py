@@ -85,14 +85,16 @@ def main() -> None:
     trainer = Trainer.from_config(cfg, enable_checkpointing=not args.compile_only)
 
     if args.compile_only:
-        import jax.numpy as jnp
-        import numpy as np
+        from neuronx_distributed_training_tpu.parallel import sharding as shd
 
         batch = next(trainer.data_module.sharded_batches(trainer.mesh))
-        lowered = trainer.train_step.lower(
-            trainer.params, trainer.opt_state, batch, jax.random.PRNGKey(0)
-        )
-        compiled = lowered.compile()
+        # compile inside the same mesh context fit() uses, so the cached
+        # executable is byte-identical to the real training step
+        with trainer.mesh, shd.use_mesh(trainer.mesh):
+            lowered = trainer.train_step.lower(
+                trainer.params, trainer.opt_state, batch, jax.random.PRNGKey(0)
+            )
+            compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
         logger.info("compile-only: train step compiled; flops=%s bytes=%s",
                     cost.get("flops"), cost.get("bytes accessed"))

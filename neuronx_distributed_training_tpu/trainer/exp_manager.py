@@ -36,6 +36,8 @@ class ExpManager:
         resume_if_exists: bool = False,
         profile_start_step: int = 0,  # 0 = profiling off
         profile_num_steps: int = 3,
+        create_wandb_logger: bool = False,
+        wandb_kwargs: Optional[dict] = None,
     ):
         base = Path(exp_dir) / name
         if version is None:
@@ -72,6 +74,16 @@ class ExpManager:
                 self._tb = SummaryWriter(log_dir=str(self.log_dir / "tb"))
             except Exception as e:  # noqa: BLE001 — TB is optional observability
                 logger.warning("TensorBoard logger unavailable: %s", e)
+        self._wandb = None
+        if create_wandb_logger:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    dir=str(self.log_dir), name=name, **(wandb_kwargs or {})
+                )
+            except Exception as e:  # noqa: BLE001 — W&B is optional
+                logger.warning("W&B logger unavailable: %s", e)
 
     @classmethod
     def from_config(cls, cfg: dict[str, Any], global_batch_size: int = 1) -> "ExpManager":
@@ -89,6 +101,8 @@ class ExpManager:
             resume_if_exists=bool(em.get("resume_if_exists", False)),
             profile_start_step=int(em.get("profile_start_step", 0) or 0),
             profile_num_steps=int(em.get("profile_num_steps", 3)),
+            create_wandb_logger=bool(em.get("create_wandb_logger", False)),
+            wandb_kwargs=dict(em.get("wandb_logger_kwargs", {}) or {}),
         )
 
     # -- profiling (jax.profiler -> TensorBoard profile plugin; the TPU-native
@@ -133,6 +147,8 @@ class ExpManager:
         if self._tb is not None:
             for k, v in flat.items():
                 self._tb.add_scalar(k, v, step)
+        if self._wandb is not None:
+            self._wandb.log(flat, step=step)
         with open(self._metrics_file, "a") as f:
             f.write(json.dumps({"step": step, **flat}) + "\n")
 
@@ -145,6 +161,8 @@ class ExpManager:
         if self._tb is not None:
             self._tb.flush()
             self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()
 
 
 def _is_scalar(v: Any) -> bool:

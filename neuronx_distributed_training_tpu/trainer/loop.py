@@ -70,6 +70,7 @@ class Trainer:
     checkpointer: Optional[Checkpointer]
     max_steps: int
     step: int = 0
+    pre_fit: Optional[Callable] = None  # runs once before the loop (DPO ref pass)
 
     # -- assembly -----------------------------------------------------------
 
@@ -92,24 +93,67 @@ class Trainer:
         model_cfg, loss_fn, init_fn, specs_fn = build_model(cfg, policy)
         seed = int(cfg.get("seed", 1234))
         params = init_fn(jax.random.PRNGKey(seed))
+
+        # DPO swaps the loss for the preference objective; the pre-fit
+        # reference-logprob pass runs in fit() (reference base_dpo.py:23-66)
+        alignment = str(cfg.get("model_alignment_strategy", "") or "").lower()
+        if alignment == "dpo":
+            from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
+
+            if not isinstance(model_cfg, llama.LlamaConfig):
+                raise NotImplementedError("DPO is wired for the llama family only")
+            dpo_cfg = dict((cfg.get("model", {}) or {}).get("dpo", {}) or {})
+            mc_ref = model_cfg
+
+            def forward_logits(p, batch):
+                out, _ = llama.forward(p, {"input_ids": batch["input_ids"]}, mc_ref, policy)
+                return out
+
+            loss_fn = make_dpo_loss_fn(
+                forward_logits, beta=float(dpo_cfg.get("beta", 0.1))
+            )
+
+        # LoRA: inject adapters + freeze base weights (reference
+        # llama_model.py:51-65 -> nxd lora_config)
+        trainable = None
+        lora_block = dict((cfg.get("model", {}) or {}).get("lora", {}) or {})
+        if lora_block:
+            from neuronx_distributed_training_tpu.peft import (
+                LoraConfig as _LoraConfig,
+                add_lora,
+                lora_param_specs,
+                trainable_mask,
+            )
+
+            lora_cfg = _LoraConfig.from_config(lora_block)
+            params = add_lora(params, lora_cfg, jax.random.PRNGKey(seed + 1))
+            trainable = trainable_mask(params)
+            base_specs_fn = specs_fn
+            specs_fn = lambda **kw: lora_param_specs(base_specs_fn(**kw), lora_cfg)
+
         pp = int(mesh.shape.get("pipe", 1))
         num_micro_in_step = sched["num_microbatches"]
-        # eval always uses the plain (unpipelined) forward — forward-only has
-        # no pipeline to fill, and val batches need no microbatch divisibility
         eval_loss_fn = loss_fn
+        if pp > 1 and alignment == "dpo":
+            raise NotImplementedError("DPO + pipeline parallelism not supported yet")
         if pp > 1:
             # pipeline path: microbatching moves inside the pipelined loss
             # (reference base.py:374-383 run_train); layer stack sharded over
-            # "pipe" IS the partitioning
-            from neuronx_distributed_training_tpu.parallel.pipeline import pipeline_loss
-            from neuronx_distributed_training_tpu.trainer.step import microbatch_split
+            # "pipe" IS the partitioning.  vp > 1 stores the stack in the
+            # interleaved [vp, pp, Lc, ...] layout (reference VPP,
+            # base.py:85,155) — note checkpoints then carry that layout.
+            from jax.sharding import PartitionSpec as P
 
             from neuronx_distributed_training_tpu.parallel.pipeline import (
+                pipeline_loss,
                 stage_layer_slice,
+                to_interleaved,
             )
+            from neuronx_distributed_training_tpu.trainer.step import microbatch_split
 
+            vp = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
             # fail early with a clear message instead of an opaque GSPMD error
-            stage_layer_slice(int(getattr(model_cfg, "num_layers", 0) or 0), pp)
+            stage_layer_slice(int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
             hooks = pipeline_hooks_for(cfg, model_cfg, policy)
             nm = sched["num_microbatches"]
             embed_fn, stage_fn, stage_loss_fn = hooks
@@ -119,11 +163,23 @@ class Trainer:
                 loss = pipeline_loss(
                     p, p["layers"], mbs,
                     embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=stage_loss_fn,
-                    mesh=mesh, num_microbatches=nm,
+                    mesh=mesh, num_microbatches=nm, virtual_pipeline_size=vp,
                 )
                 return loss, {}
 
+            # eval reuses the pipelined loss: under pp the layer stack lives in
+            # the pipeline layout (interleaved when vp>1), so the plain forward
+            # cannot run on it; val batches are gbs-shaped, satisfying the
+            # microbatch split
+            eval_loss_fn = loss_fn
             pspecs = specs_fn(pipeline=True)
+            if vp > 1:
+                params["layers"] = to_interleaved(params["layers"], pp, vp)
+                # [L, ...] -> [vp, pp, Lc, ...]: spec grows (vp, pipe, Lc) dims
+                pspecs["layers"] = jax.tree_util.tree_map(
+                    lambda s: P(None, s[0], None, *tuple(s)[1:]), pspecs["layers"],
+                    is_leaf=lambda x: isinstance(x, P),
+                )
             num_micro_in_step = 1
         else:
             pspecs = specs_fn()
@@ -142,6 +198,7 @@ class Trainer:
         step_fn = make_train_step(
             loss_fn, opt_cfg, lr_schedule, policy,
             num_microbatches=num_micro_in_step,
+            trainable_mask=trainable,
         )
         jstep = jit_train_step(step_fn, mesh, pspecs, ospecs)
         eval_fn = jax.jit(make_eval_step(eval_loss_fn)) if val_data_module else None
@@ -173,12 +230,41 @@ class Trainer:
             ck_cfg = dataclasses.replace(ck_cfg, dir=exp.checkpoint_dir)
             checkpointer = Checkpointer(ck_cfg)
 
+        pre_fit = None
+        if alignment == "dpo":
+            def pre_fit(trainer: "Trainer") -> None:
+                """Frozen-policy reference-logprob pass + column attach
+                (reference base_dpo.py:23-66 on_train_start)."""
+                dm = trainer.data_module
+                if not hasattr(dm, "attach_reference_logprobs"):
+                    return  # caller supplied reference columns already
+                if "reference_chosen_logps" in getattr(dm, "arrays", {}):
+                    return
+                from neuronx_distributed_training_tpu.alignment.dpo import (
+                    compute_reference_logprobs,
+                )
+
+                n = dm.sampler.total_samples
+                order = np.arange(n)
+                bs = min(trainer.data_module.global_batch_size, n)
+                batches = (
+                    {k: v[order[i:i + bs]] for k, v in dm.arrays.items()}
+                    for i in range(0, n - bs + 1, bs)
+                )
+                cols = compute_reference_logprobs(trainer.params, batches, forward_logits)
+                # trailing partial batch (if any) computed on the remainder
+                if n % bs:
+                    rem = {k: v[order[n - (n % bs):]] for k, v in dm.arrays.items()}
+                    extra = compute_reference_logprobs(trainer.params, [rem], forward_logits)
+                    cols = {k: np.concatenate([cols[k], extra[k]]) for k in cols}
+                dm.attach_reference_logprobs(cols)
+
         return cls(
             cfg=cfg, mesh=mesh, policy=policy, model_cfg=model_cfg, loss_fn=loss_fn,
             params=params, opt_state=opt_state, param_specs=pspecs, opt_specs=ospecs,
             train_step=jstep, eval_step=eval_fn, data_module=data_module,
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
-            max_steps=max_steps,
+            max_steps=max_steps, pre_fit=pre_fit,
         )
 
     # -- resume -------------------------------------------------------------
@@ -211,6 +297,8 @@ class Trainer:
         )
 
         self.maybe_resume()
+        if self.pre_fit is not None and self.step == 0:
+            self.pre_fit(self)
         last_metrics: dict[str, float] = {}
         batches = self.data_module.sharded_batches(self.mesh)
         try:

@@ -111,8 +111,10 @@ def make_train_step(
 
 
 def make_eval_step(loss_fn: LossFn) -> Callable:
-    def eval_step(params, batch, step_key):
-        loss, _aux = loss_fn(params, batch, step_key)
+    def eval_step(params, batch, step_key=None):
+        # key=None signals eval mode: models with dropout (GPT) must run
+        # deterministically during validation
+        loss, _aux = loss_fn(params, batch, None)
         return {"val_loss": loss.astype(jnp.float32)}
 
     return eval_step

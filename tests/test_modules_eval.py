@@ -1,0 +1,145 @@
+"""SFT/DPO/Megatron data modules, generation, eval metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_training_tpu.data.modules import (
+    DPODataModule,
+    MegatronDataModule,
+    SFTDataModule,
+    load_alignment_records,
+)
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.models.generate import generate
+from neuronx_distributed_training_tpu.tools.evaluate import (
+    evaluate_sft,
+    exact_match,
+    rouge_l,
+    score,
+    token_f1,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+
+class CharTokenizer:
+    """Deterministic toy tokenizer: one token per character."""
+
+    eos_token_id = 1
+    bos_token_id = 2
+
+    def encode(self, s):
+        return [3 + (ord(c) % 60) for c in s]
+
+
+class TestSFTDataModule:
+    def test_packed_batches(self):
+        records = [{"input": f"q{i}", "output": "answer" * (i % 3 + 1)} for i in range(20)]
+        dm = SFTDataModule(records, CharTokenizer(), seq_length=32, global_batch_size=2)
+        b = next(dm.global_batches())
+        assert b["input_ids"].shape == (2, 32)
+        assert b["loss_mask"].shape == (2, 32)
+        # prompt positions masked: at least some zeros and ones
+        assert 0 < b["loss_mask"].sum() < b["loss_mask"].size
+
+    def test_padded_mode(self):
+        records = [{"input": "hi", "output": "there"}] * 8
+        dm = SFTDataModule(records, CharTokenizer(), seq_length=16,
+                           global_batch_size=4, packing=False)
+        b = next(dm.global_batches())
+        assert b["input_ids"].shape == (4, 16)
+
+    def test_too_small_raises(self):
+        with pytest.raises(ValueError, match="too small"):
+            SFTDataModule([{"input": "a", "output": "b"}], CharTokenizer(),
+                          seq_length=512, global_batch_size=8)
+
+
+class TestDPODataModule:
+    def make(self):
+        records = [
+            {"prompt": f"q{i}", "chosen": "good answer", "rejected": "bad"}
+            for i in range(8)
+        ]
+        return DPODataModule(records, CharTokenizer(), seq_length=24, global_batch_size=4)
+
+    def test_batch_keys(self):
+        dm = self.make()
+        b = next(dm.global_batches())
+        assert set(b) >= {"chosen_input_ids", "chosen_loss_mask",
+                          "rejected_input_ids", "rejected_loss_mask"}
+        assert b["chosen_input_ids"].shape == (4, 24)
+
+    def test_attach_reference_logprobs(self):
+        dm = self.make()
+        dm.attach_reference_logprobs({
+            "reference_chosen_logps": np.zeros(8, np.float32),
+            "reference_rejected_logps": np.ones(8, np.float32),
+        })
+        b = next(dm.global_batches())
+        assert b["reference_rejected_logps"].shape == (4,)
+        with pytest.raises(ValueError, match="length"):
+            dm.attach_reference_logprobs({"x": np.zeros(3)})
+
+
+class TestMegatronDataModule:
+    def test_end_to_end(self, tmp_path):
+        from neuronx_distributed_training_tpu.data.megatron import write_indexed_dataset
+
+        rng = np.random.Generator(np.random.PCG64(0))
+        docs = [rng.integers(0, 100, 50, dtype=np.int32) for _ in range(20)]
+        write_indexed_dataset(tmp_path / "c", docs)
+        dm = MegatronDataModule(tmp_path / "c", seq_length=16, global_batch_size=4,
+                                max_steps=3)
+        b = next(dm.global_batches())
+        assert b["input_ids"].shape == (4, 16)
+        np.testing.assert_array_equal(b["input_ids"][0][1:], b["labels"][0][:-1])
+
+
+class TestGenerate:
+    def test_greedy_deterministic_and_eos(self):
+        cfg = llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=1,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        )
+        policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
+
+        def logits_of(p, ids):
+            out, _ = llama.forward(p, {"input_ids": ids}, cfg, policy)
+            return out
+
+        prompts = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        out1 = generate(params, prompts, jnp.asarray([4]), logits_of,
+                        max_new_tokens=6, eos_id=1)
+        out2 = generate(params, prompts, jnp.asarray([4]), logits_of,
+                        max_new_tokens=6, eos_id=1)
+        assert out1.shape == (1, 10)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompts))
+
+
+class TestEvalMetrics:
+    def test_rouge_l(self):
+        assert rouge_l("the cat sat", "the cat sat") == 1.0
+        assert rouge_l("totally different", "the cat sat") == 0.0
+        assert 0 < rouge_l("the cat stood", "the cat sat") < 1.0
+
+    def test_exact_and_f1(self):
+        assert exact_match("The Cat!", "the cat") == 1.0
+        assert token_f1("a b c", "a b d") == pytest.approx(2 / 3)
+
+    def test_evaluate_sft_driver(self):
+        records = [{"input": "2+2", "output": "four"}, {"input": "1+1", "output": "two"}]
+        gen = lambda prompt: "four" if "2+2" in prompt else "three"
+        m = evaluate_sft(records, gen)
+        assert m["exact_match"] == 0.5
+        assert set(m) == {"rouge_l", "f1", "exact_match"}
+
+    def test_load_jsonl(self, tmp_path):
+        f = tmp_path / "d.jsonl"
+        f.write_text('{"input": "a", "output": "b"}\n{"input": "c", "output": "d"}\n')
+        recs = load_alignment_records(f)
+        assert len(recs) == 2 and recs[1]["output"] == "d"
