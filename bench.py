@@ -4,10 +4,17 @@ Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ..., "vs_baseline": 
 The driver-designated metric (BASELINE.json) is Llama-3-8B pretrain MFU with a
 north star of >= 45% MFU; vs_baseline is measured_mfu / 45%.
 
-On TPU the model is Llama-3-8B per-layer shapes (hidden 4096 / ffn 14336 /
-32 heads / 8 KV heads / vocab 128256 / seq 8192) with the layer count scaled to
-fit one chip — MFU is per-layer-shape-bound, so this measures the same thing the
-full 32-layer multi-chip run would.  On CPU it shrinks to a smoke config.
+Regimes: the baseline config (reference ``hf_llama3_8B_config.yaml:45-107``)
+specifies ``mixed_precision`` (bf16 compute, fp32 master weights + optimizer
+state).  That is the headline number when it fits on the chip; the pure-bf16
+regime (the reference's bf16+SR) is measured alongside and reported in the same
+JSON.  On TPU the model is Llama-3-8B per-layer shapes (hidden 4096 / ffn 14336
+/ 32 heads / 8 KV heads / vocab 128256 / seq 8192) with the layer count scaled
+to fit one chip — MFU is per-layer-shape-bound, so this measures the same thing
+the full 32-layer multi-chip run would.  On CPU it shrinks to a smoke config.
+
+Failure behavior: every error path still emits the JSON line (value 0.0 +
+"error" field) so the driver records a diagnostic instead of a traceback.
 """
 
 from __future__ import annotations
@@ -17,74 +24,90 @@ import functools
 import json
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from neuronx_distributed_training_tpu.models import llama
-from neuronx_distributed_training_tpu.optim.adamw import (
-    AdamWConfig,
-    init_opt_state,
-    opt_state_specs,
-)
-from neuronx_distributed_training_tpu.optim.lr import constant_lr
-from neuronx_distributed_training_tpu.parallel import sharding as shd
-from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
-from neuronx_distributed_training_tpu.trainer.step import jit_train_step, make_train_step
-from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
-from neuronx_distributed_training_tpu.utils import perf
+import traceback
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def has_flash() -> bool:
-    try:
-        from neuronx_distributed_training_tpu.ops import flash_attention  # noqa: F401
-
-        return True
-    except ImportError:
-        return False
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--seq", type=int, default=None)
-    ap.add_argument("--layers", type=int, default=None)
-    ap.add_argument("--mbs", type=int, default=1)
-    ap.add_argument("--attn", choices=["auto", "core", "flash"], default="auto")
-    args = ap.parse_args()
+def fail_json(err: str, **extra) -> None:
+    emit({
+        "metric": "llama3_8B_pretrain_mfu",
+        "value": 0.0,
+        "unit": "percent_mfu",
+        "vs_baseline": 0.0,
+        "error": err[-2000:],
+        **extra,
+    })
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    if args.attn == "auto":
-        attn_impl = "flash" if (on_tpu and has_flash()) else "core"
-    else:
-        attn_impl = args.attn
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices()[0];"
+    "jnp.zeros(8).block_until_ready();"
+    "print('PROBE_OK', d.platform)"
+)
+
+
+def acquire_device(retries: int = 3, probe_timeout_s: float = 180.0,
+                   delay_s: float = 30.0, platform: str | None = None):
+    """Get a usable JAX device without risking an indefinite in-process hang.
+
+    The tunnelled TPU backend can hang or be transiently UNAVAILABLE (round-1
+    failure mode: rc=1 at driver bench time).  ``jax.devices()`` has no timeout
+    and a hung call poisons the process, so availability is probed in a
+    SUBPROCESS with a hard timeout first; only after a successful probe do we
+    initialize in-process.  Returns (device | None, diagnostic | None).
+    """
+    import subprocess
+
+    if platform == "cpu":
+        # cpu is in-process safe (no tunnel involved); tpu still goes through
+        # the subprocess probe below so a hung backend can't hang the bench
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        return jax.devices()[0], None
+
+    last = ""
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=probe_timeout_s,
+            )
+            if "PROBE_OK" in r.stdout:
+                log(f"bench: backend probe ok ({r.stdout.strip().split()[-1]})")
+                import jax
+
+                return jax.devices()[0], (last or None)
+            last = (r.stderr or r.stdout).strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"backend probe timed out after {probe_timeout_s:.0f}s"
+        except Exception as e:  # noqa: BLE001 — diagnostic path
+            last = f"{type(e).__name__}: {e}"
+        log(f"bench: backend attempt {attempt + 1}/{retries} failed: {last}")
+        if attempt + 1 < retries:
+            time.sleep(delay_s)
+    return None, last
+
+
+def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | None,
+                hbm_bytes: int, bytes_per_param: float):
+    """Llama-3-8B per-layer shapes, layer count auto-sized to HBM."""
     if on_tpu:
-        # Flash attention handles seq 8192; naive core attention's O(s^2)
-        # transients need the shorter default on small-HBM chips.
-        seq = args.seq or (8192 if attn_impl == "flash" else 4096)
         h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
-        if args.layers:
-            layers = args.layers
-        else:
-            # Auto-size the layer count to HBM: pure-bf16 regime costs
-            # ~6 bytes/param (param + m + v) plus transient bf16 grads (2).
-            try:
-                hbm = dev.memory_stats()["bytes_limit"]
-            except Exception:
-                hbm = 16 << 30
+        if layers is None:
             per_layer = h * (nh + 2 * nkv) * (h // nh) + nh * (h // nh) * h + 3 * h * ffn
             vocab_params = 2 * vocab * h
-            budget_params = hbm * 0.60 / 8.0
+            budget_params = hbm_bytes * 0.60 / bytes_per_param
             layers = max(1, min(32, int((budget_params - vocab_params) // per_layer)))
-        cfg = llama.LlamaConfig(
+        return llama.LlamaConfig(
             vocab_size=vocab,
             hidden_size=h,
             intermediate_size=ffn,
@@ -97,35 +120,37 @@ def main() -> None:
             attention_impl=attn_impl,
             activations_checkpoint_granularity="selective",
         )
-    else:
-        seq = args.seq or 512
-        cfg = llama.LlamaConfig(
-            vocab_size=1024,
-            hidden_size=256,
-            intermediate_size=704,
-            num_layers=args.layers or 2,
-            num_attention_heads=8,
-            num_kv_heads=4,
-            max_position_embeddings=seq,
-            attention_impl="core" if attn_impl == "auto" else attn_impl,
-        )
-        args.steps = min(args.steps, 4)
-        args.warmup = min(args.warmup, 1)
-
-    # Pure-bf16 regime on TPU (the reference's bf16+SR regime,
-    # training_orchestrator.py precision matrix) — 6 bytes/param keeps the
-    # Llama3-8B layer shapes + full vocab resident on a small-HBM chip.
-    policy = (
-        DtypePolicy.from_precision_config(
-            {"type": "bf16SR", "optimizer_dtype": "bf16", "grad_accum_dtype": "bf16"}
-        )
-        if on_tpu
-        else DtypePolicy.from_precision_config("mixed_precision")
+    return llama.LlamaConfig(
+        vocab_size=1024,
+        hidden_size=256,
+        intermediate_size=704,
+        num_layers=layers or 2,
+        num_attention_heads=8,
+        num_kv_heads=4,
+        max_position_embeddings=seq,
+        attention_impl=attn_impl,
     )
-    mesh = build_mesh(MeshConfig(), devices=[dev])
-    log(f"bench: device={dev.device_kind} layers={cfg.num_layers} seq={seq} "
-        f"mbs={args.mbs} attn={cfg.attention_impl}")
 
+
+def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> dict:
+    """One timed regime run; returns {ms_per_step, tokens_per_sec, mfu}."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_training_tpu.models import llama
+    from neuronx_distributed_training_tpu.optim.adamw import (
+        AdamWConfig, init_opt_state, opt_state_specs,
+    )
+    from neuronx_distributed_training_tpu.optim.lr import constant_lr
+    from neuronx_distributed_training_tpu.parallel import sharding as shd
+    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from neuronx_distributed_training_tpu.trainer.step import (
+        jit_train_step, make_train_step,
+    )
+    from neuronx_distributed_training_tpu.utils import perf
+
+    mesh = build_mesh(MeshConfig(), devices=[dev])
     pspecs = llama.param_specs(cfg)
     with mesh, shd.use_mesh(mesh):
         params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
@@ -145,14 +170,14 @@ def main() -> None:
         jstep = jit_train_step(step, mesh, pspecs, ospecs)
 
         ids = jax.random.randint(
-            jax.random.PRNGKey(1), (args.mbs, seq), 0, cfg.vocab_size, dtype=jnp.int32
+            jax.random.PRNGKey(1), (mbs, seq), 0, cfg.vocab_size, dtype=jnp.int32
         )
         batch = {"input_ids": ids, "labels": ids}
         batch = jax.device_put(batch, ns(P(("data", "expert"))))
         key = jax.random.PRNGKey(2)
 
         t_compile = time.perf_counter()
-        for _ in range(args.warmup):
+        for _ in range(warmup):
             params, opt_state, metrics = jstep(params, opt_state, batch, key)
         # A host scalar fetch is the only reliable execution fence on remote
         # (tunnelled) TPU backends — block_until_ready alone doesn't flush.
@@ -161,47 +186,158 @@ def main() -> None:
 
         # Measure fetch round-trip on settled buffers: min of several samples so
         # a one-off connection-setup stall can't dominate the correction.
+        # Only never-fetched buffers: a fetched jax.Array caches its host value.
         rtts = []
-        # only never-fetched buffers: a fetched jax.Array caches its host value,
-        # so re-fetching "loss" (read at the warmup log) measures ~0
         for m in ("grad_norm", "lr"):
             t_rtt = time.perf_counter()
             _ = float(metrics[m])
             rtts.append(time.perf_counter() - t_rtt)
         rtt = min(rtts)
         t0 = time.perf_counter()
-        for _ in range(args.steps):
+        for _ in range(steps):
             params, opt_state, metrics = jstep(params, opt_state, batch, key)
         _ = float(metrics["loss"])  # fence: forces the whole dependent chain
         elapsed = time.perf_counter() - t0
         # the rtt correction must stay a correction — never let it swallow the
         # measurement and report a fantasy number
         rtt = min(rtt, 0.1 * elapsed)
-        dt = (elapsed - rtt) / args.steps
+        dt = (elapsed - rtt) / steps
         log(f"bench: fetch rtt {rtt * 1e3:.0f} ms")
 
-    tokens_per_step = args.mbs * seq
-    tokens_per_sec = tokens_per_step / dt
+    tokens_per_sec = mbs * seq / dt
     fwd_ft = perf.flops_for_config(cfg, seq)
     step_ft = perf.train_step_flops_per_token(fwd_ft)
     peak = perf.detect_peak_tflops(dev)
     mfu = perf.mfu(tokens_per_sec, step_ft, peak)
     log(f"bench: {dt * 1e3:.1f} ms/step, {tokens_per_sec:,.0f} tok/s/chip, "
         f"MFU {100 * mfu:.1f}% (peak {peak} TF)")
-
-    print(json.dumps({
-        "metric": "llama3_8B_pretrain_mfu",
-        "value": round(100 * mfu, 2),
-        "unit": "percent_mfu",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+    return {
         "ms_per_step": round(dt * 1e3, 2),
-        "device": dev.device_kind,
-        "attn_impl": cfg.attention_impl,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": mfu,
+        "peak_tflops": peak,
         "num_layers": cfg.num_layers,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--mbs", type=int, default=1)
+    ap.add_argument("--attn", choices=["auto", "core", "flash"], default="auto")
+    ap.add_argument("--regime", choices=["both", "mixed", "bf16"], default="both")
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="force a platform (cpu for local smoke runs)")
+    args = ap.parse_args()
+
+    dev, backend_err = acquire_device(platform=args.platform)
+    if dev is None:
+        fail_json(f"no backend available: {backend_err}")
+        return
+
+    from neuronx_distributed_training_tpu.models import llama
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    on_tpu = dev.platform == "tpu"
+    if args.attn == "auto":
+        attn_impl = "flash" if on_tpu else "core"
+    else:
+        attn_impl = args.attn
+    # Flash attention handles seq 8192; naive core attention's O(s^2)
+    # transients need the shorter default on small-HBM chips.
+    seq = args.seq or ((8192 if attn_impl == "flash" else 4096) if on_tpu else 512)
+    steps, warmup = (args.steps, args.warmup) if on_tpu else (
+        min(args.steps, 4), min(args.warmup, 1))
+    try:
+        hbm = dev.memory_stats()["bytes_limit"]
+    except Exception:
+        hbm = 16 << 30
+
+    # Regime definitions (reference precision matrix,
+    # training_orchestrator.py:104-137):
+    #  - mixed_precision: bf16 compute, fp32 master + opt state (+fp32 grad
+    #    accum) -> ~18 resident bytes/param incl. transient fp32 grads
+    #  - bf16SR: everything bf16 -> ~8 bytes/param incl. transient grads
+    regimes = {
+        "mixed_precision": (DtypePolicy.from_precision_config("mixed_precision"), 18.0),
+        "bf16": (DtypePolicy.from_precision_config(
+            {"type": "bf16SR", "optimizer_dtype": "bf16", "grad_accum_dtype": "bf16"}
+        ), 8.0),
+    }
+    if args.regime == "mixed":
+        wanted = ["mixed_precision"]
+    elif args.regime == "bf16":
+        wanted = ["bf16"]
+    else:
+        wanted = ["mixed_precision", "bf16"] if on_tpu else ["mixed_precision"]
+
+    results: dict[str, dict] = {}
+    errors: dict[str, str] = {}
+    for name in wanted:
+        policy, bpp = regimes[name]
+        cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp)
+        log(f"bench[{name}]: device={dev.device_kind} layers={cfg.num_layers} "
+            f"seq={seq} mbs={args.mbs} attn={cfg.attention_impl}")
+        tries = [cfg.num_layers]
+        if cfg.num_layers > 1:
+            tries.append(max(1, cfg.num_layers // 2))  # OOM backoff
+        for n_layers in tries:
+            try:
+                if n_layers != cfg.num_layers:
+                    import dataclasses as _dc
+
+                    cfg = _dc.replace(cfg, num_layers=n_layers)
+                    log(f"bench[{name}]: retrying with layers={n_layers}")
+                results[name] = run_bench(
+                    dev, cfg, policy, seq, args.mbs, steps, warmup)
+                errors.pop(name, None)  # a successful backoff clears the record
+                break
+            except Exception as e:  # noqa: BLE001 — keep the other regime alive
+                errors[name] = f"{type(e).__name__}: {e}"
+                log(f"bench[{name}] failed: {errors[name]}\n{traceback.format_exc()}")
+                oom = any(s in errors[name] for s in
+                          ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+                           "Allocat", "HBM"))
+                if not oom:
+                    break  # fewer layers won't fix a non-memory failure
+
+    if not results:
+        fail_json("; ".join(f"{k}: {v}" for k, v in errors.items()) or "no regime ran",
+                  device=getattr(dev, "device_kind", str(dev)))
+        return
+
+    # headline: the baseline regime (mixed_precision) when available
+    headline = "mixed_precision" if "mixed_precision" in results else next(iter(results))
+    r = results[headline]
+    payload = {
+        "metric": "llama3_8B_pretrain_mfu",
+        "value": round(100 * r["mfu"], 2),
+        "unit": "percent_mfu",
+        "vs_baseline": round(r["mfu"] / 0.45, 4),
+        "regime": headline,
+        "tokens_per_sec_per_chip": r["tokens_per_sec"],
+        "ms_per_step": r["ms_per_step"],
+        "device": dev.device_kind,
+        "attn_impl": attn_impl,
+        "num_layers": r["num_layers"],
         "seq_len": seq,
-    }))
+        "note": "layer count scaled to single-chip HBM; MFU is per-layer-shape-bound",
+    }
+    for name, res in results.items():
+        payload[f"mfu_{name}"] = round(100 * res["mfu"], 2)
+    if errors:
+        payload["regime_errors"] = errors
+    if backend_err:
+        payload["backend_retries"] = backend_err
+    emit(payload)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — the driver must always get JSON
+        traceback.print_exc()
+        fail_json(f"{type(e).__name__}: {e}")
