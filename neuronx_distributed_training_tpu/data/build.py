@@ -1,0 +1,182 @@
+"""Config -> DataModule dispatch: the ``cfg.data`` wiring layer.
+
+The reference selects and builds the real data pipeline from YAML
+(``examples/training.py:71-91``): ``model_source`` + ``model_alignment_strategy``
+pick between ``HFDataModule`` (pretokenized arrow dir,
+``hf_data_module.py:15-44``), ``MegatronDataModule`` (mmap ``data_prefix``),
+and ``ModelAlignmentDataModule`` (jsonl/arrow SFT/DPO/ORPO).  This module is
+that dispatch for the TPU stack:
+
+    train_dm, val_dm = build_data_module(cfg, sched, seed=seed)
+
+Synthetic data is used ONLY when explicitly configured (``data.synthetic:
+true``); a config with no data source is an error, not a silent random-token
+run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from neuronx_distributed_training_tpu.data.loader import (
+    DataModule,
+    HFDataModule,
+    SyntheticDataModule,
+)
+from neuronx_distributed_training_tpu.data.modules import (
+    DPODataModule,
+    MegatronDataModule,
+    SFTDataModule,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def alignment_strategy(cfg: Any) -> tuple[str, dict]:
+    """Normalize ``model_alignment_strategy`` to ``(name, params)``.
+
+    The reference uses a dict block (``hf_llama3_8B_SFT_config.yaml:108-110``:
+    ``model_alignment_strategy: {sft: {packing: true}}``); a bare string form
+    is also accepted.
+    """
+    blk = cfg.get("model_alignment_strategy", None)
+    if not blk:
+        return "", {}
+    if isinstance(blk, str):
+        return blk.lower(), {}
+    for name in ("sft", "dpo", "orpo"):
+        if name in blk:
+            return name, dict(blk.get(name) or {})
+    raise ValueError(
+        f"model_alignment_strategy must be a string or contain one of "
+        f"sft/dpo/orpo, got keys {list(blk)}"
+    )
+
+
+class CharTokenizer:
+    """Offline char-level tokenizer (``tokenizer.library: char``) for smoke
+    runs and tests where no HF tokenizer files exist."""
+
+    bos_token_id = 1
+    eos_token_id = 2
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return [3 + (b % (self.vocab_size - 3)) for b in text.encode()]
+
+
+def build_tokenizer(data_cfg: dict) -> Any:
+    """Tokenizer from ``data.tokenizer`` (reference builds NeMo/HF tokenizers
+    from ``cfg.data.tokenizer.type``, ``megatron/data_module.py:318-339``)."""
+    tok_cfg = dict(data_cfg.get("tokenizer") or {})
+    library = str(tok_cfg.get("library", "huggingface")).lower()
+    if library == "char":
+        return CharTokenizer(int(tok_cfg.get("vocab_size", 512)))
+    name = tok_cfg.get("type") or tok_cfg.get("name")
+    if not name:
+        raise ValueError("data.tokenizer.type is required for this data path")
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(str(name))
+
+
+def build_data_module(
+    cfg: Any,
+    sched: dict,
+    *,
+    seed: int = 1234,
+    vocab_size: Optional[int] = None,
+) -> tuple[Optional[DataModule], Optional[DataModule]]:
+    """(train, val) DataModules from ``cfg.data`` (+ alignment strategy).
+
+    Returns ``(None, None)`` only for ``data.synthetic: true`` with no vocab
+    hint — the caller then builds SyntheticDataModule once the model config
+    (and its vocab size) exists.
+    """
+    data = dict(cfg.get("data", {}) or {})
+    gbs = sched["global_batch_size"]
+    seq = int(data.get("seq_length")
+              or (cfg.get("model", {}) or {}).get("encoder_seq_length")
+              or (cfg.get("model", {}) or {}).get("max_position_embeddings")
+              or 2048)
+    strategy, strat_params = alignment_strategy(cfg)
+    train_dir = data.get("train_dir")
+    val_dir = data.get("val_dir")
+    data_prefix = data.get("data_prefix")
+    max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 1000))
+
+    if strategy in ("sft",):
+        tokenizer = build_tokenizer(data)
+        packing = bool(strat_params.get("packing", True))
+        n_head = data.get("dev_choose_samples")
+
+        def sft(path):
+            from neuronx_distributed_training_tpu.data.modules import (
+                load_alignment_records,
+            )
+
+            records = load_alignment_records(path)
+            if n_head:
+                records = records[: int(n_head)]
+            return SFTDataModule(
+                records, tokenizer, seq, gbs, packing=packing, seed=seed,
+            )
+
+        if not train_dir:
+            raise ValueError("SFT needs data.train_dir (jsonl/json/arrow)")
+        return sft(train_dir), (sft(val_dir) if val_dir else None)
+
+    if strategy in ("dpo", "orpo"):
+        tokenizer = build_tokenizer(data)
+
+        def dpo(path):
+            return DPODataModule(
+                path, tokenizer, seq, gbs, seed=seed,
+                max_prompt_length=strat_params.get("max_prompt_length"),
+                truncation_mode=str(strat_params.get("truncation_mode", "keep_start")),
+            )
+
+        if not train_dir:
+            raise ValueError(f"{strategy.upper()} needs data.train_dir (jsonl/json/arrow)")
+        return dpo(train_dir), (dpo(val_dir) if val_dir else None)
+
+    if data_prefix:
+        # Megatron mmap pretraining (reference megatron/data_module.py:89-130);
+        # data_prefix may be [weight, path, ...] — single-corpus only here
+        prefix = data_prefix
+        if isinstance(prefix, (list, tuple)):
+            paths = [p for p in prefix if isinstance(p, str)]
+            if len(paths) != 1:
+                raise NotImplementedError(
+                    f"blended data_prefix not supported yet (got {prefix})"
+                )
+            prefix = paths[0]
+        train = MegatronDataModule(
+            prefix, seq, gbs, max_steps=max_steps, seed=seed,
+        )
+        return train, None
+
+    if train_dir:
+        # HF pretokenized-arrow pretraining (reference hf_data_module.py:15-44)
+        train = HFDataModule(train_dir, gbs, seed=seed)
+        val = HFDataModule(val_dir, gbs, seed=seed) if val_dir else None
+        return train, val
+
+    if data.get("synthetic"):
+        if vocab_size is None:
+            return None, None  # caller builds it with the model's vocab
+        return (
+            SyntheticDataModule(
+                vocab_size=vocab_size, seq_len=seq, global_batch_size=gbs, seed=seed
+            ),
+            None,
+        )
+
+    raise ValueError(
+        "cfg.data has no data source: set data.train_dir (HF arrow dir or "
+        "jsonl for alignment), data.data_prefix (Megatron mmap), or "
+        "data.synthetic: true for random-token smoke runs"
+    )

@@ -29,7 +29,14 @@ class MegatronDataModule(DataModule):
 
     ``num_samples`` defaults to ``max_steps * global_batch_size`` the way the
     reference sizes its train split (``:89-130``).
+
+    ``labels_pre_shifted``: GPTDataset emits ``input_ids = tokens[:-1]``,
+    ``labels = tokens[1:]`` (the reference's Megatron convention,
+    ``gpt_dataset_patch.py``), so the trainer must run the model with
+    ``shift_labels=False`` — ``Trainer.from_config`` reads this attribute.
     """
+
+    labels_pre_shifted = True
 
     def __init__(
         self,
@@ -53,8 +60,6 @@ class MegatronDataModule(DataModule):
         rows = [self.dataset[int(i)] for i in idx]
         return {
             "input_ids": np.stack([r["input_ids"] for r in rows]),
-            # GPTDataset pre-shifts labels; model must be called with
-            # shift_labels=False for exact parity, or labels re-derived.
             "labels": np.stack([r["labels"] for r in rows]),
         }
 
@@ -154,6 +159,8 @@ class DPODataModule(DataModule):
         global_batch_size: int,
         *,
         pad_id: int = 0,
+        max_prompt_length: Optional[int] = None,
+        truncation_mode: str = "keep_start",
         **kw: Any,
     ):
         if isinstance(records, (str, Path)):
@@ -166,7 +173,21 @@ class DPODataModule(DataModule):
             ids_list, lbl_list = [], []
             for r in records:
                 p_toks = list(encode(r["prompt"]))
+                # prompt-length cap + overlong-pair truncation (reference
+                # model_alignment_data_module.py max_prompt_length /
+                # truncation_mode keep_start|keep_end)
+                if max_prompt_length and len(p_toks) > int(max_prompt_length):
+                    m = int(max_prompt_length)
+                    p_toks = p_toks[:m] if truncation_mode == "keep_start" else p_toks[-m:]
                 c_toks = list(encode(r[side])) + [eos]
+                if len(p_toks) + len(c_toks) > seq_length:
+                    keep = seq_length - len(c_toks)
+                    if keep <= 0:
+                        p_toks, c_toks = [], c_toks[-seq_length:]
+                    elif truncation_mode == "keep_end":
+                        p_toks = p_toks[-keep:]
+                    else:
+                        p_toks = p_toks[:keep]
                 ids, lbl = mask_prompt_labels(p_toks, c_toks)
                 ids_list.append(ids)
                 lbl_list.append(lbl)

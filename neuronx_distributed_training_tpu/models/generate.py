@@ -6,6 +6,12 @@ Backs the SFT evaluation harness the way the reference's traced-inference
 ``[batch, max_len]`` token buffer, ``lax.fori_loop`` over positions, full-prefix
 forward per step (static shapes; a KV-cache decode path is a later perf
 optimization — eval harness workloads are small).
+
+Prompts are RIGHT-padded: row ``b`` holds its prompt at positions
+``[0, prompt_lens[b])``.  Generated tokens are written at each row's own
+front (``prompt_lens[b] + i``), so causal attention never sees padding (pad
+positions are strictly ahead of every query) and RoPE positions are the
+natural ``0..L`` — no attention mask or per-row position offsets needed.
 """
 
 from __future__ import annotations
@@ -19,9 +25,20 @@ import jax.numpy as jnp
 LogitsFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
+    """Right-pad variable-length prompts -> (ids [b, max_len], lens [b])."""
+    import numpy as np
+
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    ids = np.full((len(prompts), int(lens.max())), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, : len(p)] = p
+    return jnp.asarray(ids), jnp.asarray(lens)
+
+
 def generate(
     params: Any,
-    prompt_ids: jax.Array,  # [b, prompt_len] left-padded with pad_id
+    prompt_ids: jax.Array,  # [b, prompt_len] RIGHT-padded with pad_id
     prompt_lens: jax.Array,  # [b] true prompt lengths
     logits_of: LogitsFn,
     *,
@@ -33,7 +50,8 @@ def generate(
 ) -> jax.Array:
     """Generate up to ``max_new_tokens``; returns ``[b, prompt_len + max_new]``.
 
-    Positions after a generated EOS are filled with ``pad_id``.
+    Row ``b``'s completion occupies ``[prompt_lens[b], prompt_lens[b] + n)``;
+    positions after a generated EOS (and unused tail) hold ``pad_id``.
     """
     b, plen = prompt_ids.shape
     total = plen + max_new_tokens
@@ -41,12 +59,15 @@ def generate(
     buf = buf.at[:, :plen].set(prompt_ids)
     done0 = jnp.zeros((b,), bool)
     key = key if key is not None else jax.random.PRNGKey(0)
+    rows = jnp.arange(b)
+    lens = prompt_lens.astype(jnp.int32)
 
     def step(i, carry):
         buf, done, key = carry
-        pos = plen + i  # next position to fill
+        pos = lens + i  # [b] next position to fill, per row
         logits = logits_of(params, buf)  # [b, total, vocab]
-        next_logits = logits[:, pos - 1, :]
+        # row b predicts from its own front: logits at position pos[b]-1
+        next_logits = logits[rows, pos - 1, :]
         if temperature > 0:
             key, sub = jax.random.split(key)
             nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
@@ -54,7 +75,7 @@ def generate(
             nxt = jnp.argmax(next_logits, axis=-1)
         nxt = nxt.astype(buf.dtype)
         nxt = jnp.where(done, jnp.asarray(pad_id, buf.dtype), nxt)
-        buf = buf.at[:, pos].set(nxt)
+        buf = buf.at[rows, pos].set(nxt)
         done = done | (nxt == eos_id)
         return buf, done, key
 

@@ -356,7 +356,7 @@ def _rope_for(input_ids: jax.Array, cfg: LlamaConfig):
     return rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
 
 
-def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy):
+def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy, *, shift_labels: bool = True):
     """(embed_fn, stage_fn, loss_fn) for ``parallel.pipeline.pipeline_loss``.
 
     The decoder stack is the pipelined region; embedding and lm-head/loss run
@@ -387,7 +387,10 @@ def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy):
         logits = logits_fn(params, h, cfg, policy)
         labels = mb["labels"]
         loss_mask = mb.get("loss_mask")
-        logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
+        if shift_labels:
+            logits, labels, loss_mask = ce_ops.shift_for_next_token(
+                logits, labels, loss_mask
+            )
         loss_sum = ce_ops.cross_entropy_loss(
             logits, labels, loss_mask=loss_mask, reduction="sum"
         )

@@ -11,10 +11,12 @@ Layout is ``[batch, seq, heads, head_dim]`` throughout (the TPU-friendly layout;
 the reference's ``transpose_nki_inputs`` permutation concern disappears because
 Pallas block specs handle layout inside the kernel).
 
-GQA: K/V carry ``kv_heads`` heads and are repeated to ``heads`` on the fly; the
-reference's ``kv_shared_group_size`` KV replication trick
-(``modeling_llama.py:310-320``) is unnecessary under GSPMD — when
-``tp > kv_heads`` XLA replicates the KV shards automatically from the specs.
+GQA: K/V carry ``kv_heads`` heads and are repeated to ``heads`` on the fly.
+For the GSPMD core/flash paths the reference's ``kv_shared_group_size`` KV
+replication trick (``modeling_llama.py:310-320``) is unnecessary — XLA
+replicates KV shards from the specs when ``tp > kv_heads``.  The explicit
+shard_map ring path implements the replication itself (see
+``parallel.ring_attention``).
 """
 
 from __future__ import annotations
@@ -138,7 +140,14 @@ def attention(
         except ImportError:
             _warn_fallback("ring")
         else:
-            return ring_attention(q, k, v, causal=causal)
+            if q_offset:
+                raise ValueError(
+                    "ring attention derives global positions from the mesh; "
+                    "an explicit q_offset is not meaningful here"
+                )
+            return ring_attention(
+                q, k, v, causal=causal, sliding_window=sliding_window
+            )
     return core_attention(
         q,
         k,

@@ -38,39 +38,79 @@ from neuronx_distributed_training_tpu.parallel import sharding as shd
 NEG_INF = -1e30
 
 
-def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale, causal):
-    """One online-softmax accumulation step against KV chunk (kc, vc).
+def _block_update(qh, ks, vs, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
+                  causal, window):
+    """One online-softmax accumulation against a KV BLOCK (ks, vs).
 
-    q [b, h, sq, d]; kc/vc [b, kvh, skv, d] (un-repeated GQA heads — repeated
-    here, inside the remat boundary, so the ring rotates and the scan carries
-    only kvh heads); o_acc [b, h, sq, d]; m_acc/l_acc [b, h, sq, 1].
-    Offsets are traced scalars (global positions).
+    qh [b, h, sq, d]; ks/vs [b, h, bkv, d] (GQA heads already repeated);
+    o_acc [b, h, sq, d]; m_acc/l_acc [b, h, sq, 1].  Offsets are traced
+    scalars (global positions of query row 0 / kv row 0).
     """
-    h, kvh = q.shape[1], kc.shape[1]
-    if kvh != h:
-        kc = jnp.repeat(kc, h // kvh, axis=1)
-        vc = jnp.repeat(vc, h // kvh, axis=1)
     s = jax.lax.dot_general(
-        q, kc, (((3,), (3,)), ((0, 1), (0, 1))), preferred_element_type=jnp.float32
-    ) * scale  # [b, h, sq, skv]
+        qh, ks, (((3,), (3,)), ((0, 1), (0, 1))), preferred_element_type=jnp.float32
+    ) * scale  # [b, h, sq, bkv]
+    sq, bkv = s.shape[-2], s.shape[-1]
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, bkv), 0)
+    kv_pos = kv_off + jax.lax.broadcasted_iota(jnp.int32, (sq, bkv), 1)
     if causal:
-        sq, skv = s.shape[-2], s.shape[-1]
-        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
-        kv_pos = kv_off + jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
         s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+    if window is not None:
+        # Mixtral-style sliding window on GLOBAL positions (reference
+        # modeling_mixtral.py:145-148); composes with the ring offsets
+        s = jnp.where(kv_pos > q_pos - window, s, NEG_INF)
     m_c = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_acc, m_c)
     alpha = jnp.exp(m_acc - m_new)  # rescale of previous partials
     p = jnp.exp(s - m_new)
     l_new = alpha * l_acc + jnp.sum(p, axis=-1, keepdims=True)
     o_new = alpha * o_acc + jax.lax.dot_general(
-        p.astype(vc.dtype), vc, (((3,), (2,)), ((0, 1), (0, 1))),
+        p.astype(vs.dtype), vs, (((3,), (2,)), ((0, 1), (0, 1))),
         preferred_element_type=jnp.float32,
     )
     return o_new, m_new, l_new
 
 
-def _ring_local(q, k, v, *, axis_name, cp, causal):
+def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
+                  causal, window, block_kv):
+    """Accumulate one ring chunk BLOCKWISE over its KV length.
+
+    The fp32 score tensor is [b, h, sq, block_kv] per inner step instead of
+    [b, h, sq, s/cp] — this is what keeps 32k-sequence CP inside single-chip
+    memory (flash attention's tiling, expressed in XLA; the Pallas kernel is
+    the single-chip fast path, this is the ring body).
+    q [b, h, sq, d]; kc/vc [b, kvh, skv, d] (un-repeated GQA heads — repeated
+    here, inside the remat boundary, so the ring rotates and the scan carries
+    only kvh heads).
+    """
+    h, kvh = q.shape[1], kc.shape[1]
+    if kvh != h:
+        kc = jnp.repeat(kc, h // kvh, axis=1)
+        vc = jnp.repeat(vc, h // kvh, axis=1)
+    skv = kc.shape[2]
+    bkv = min(block_kv, skv)
+    if skv % bkv:
+        bkv = skv  # non-divisible chunk: single block (tiny cases only)
+    n_blocks = skv // bkv
+
+    if n_blocks == 1:
+        return _block_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off,
+                             scale=scale, causal=causal, window=window)
+
+    def blk(carry, i):
+        o, m, l = carry
+        ks = jax.lax.dynamic_slice_in_dim(kc, i * bkv, bkv, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vc, i * bkv, bkv, axis=2)
+        o, m, l = _block_update(q, ks, vs, o, m, l, q_off, kv_off + i * bkv,
+                                scale=scale, causal=causal, window=window)
+        return (o, m, l), None
+
+    (o_acc, m_acc, l_acc), _ = jax.lax.scan(
+        blk, (o_acc, m_acc, l_acc), jnp.arange(n_blocks)
+    )
+    return o_acc, m_acc, l_acc
+
+
+def _ring_local(q, k, v, *, axis_name, cp, causal, window, block_kv):
     """Per-rank ring attention body (runs inside shard_map).
 
     q [b, sq, h, d]; k/v [b, skv, kvh, d] (local chunks) -> o [b, sq, h, d].
@@ -92,7 +132,8 @@ def _ring_local(q, k, v, *, axis_name, cp, causal):
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     compute = jax.checkpoint(
-        functools.partial(_chunk_update, scale=scale, causal=causal)
+        functools.partial(_chunk_update, scale=scale, causal=causal,
+                          window=window, block_kv=block_kv)
     )
 
     def step(carry, t):
@@ -122,38 +163,58 @@ def ring_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    sliding_window: Optional[int] = None,
     axis_name: str = "context",
     mesh=None,
+    block_kv: int = 512,
 ) -> jax.Array:
     """Context-parallel ring attention over the active mesh.
 
     Falls back to ``core_attention`` when no mesh is active or cp == 1 (so the
     same model code runs in unit tests and CP-off configs), matching the
     dispatch contract of ``ops.attention``.
+
+    GQA with ``tp > kv_heads``: KV heads are replicated ``tp / kv_heads``
+    times (consecutively, so TP rank ``r`` holds exactly the KV head its Q
+    heads attend to) — the reference's ``kv_shared_group_size`` /
+    ``GQAQKVColumnParallelLinear(kv_size_multiplier=...)`` trick
+    (``modeling_llama.py:310-320``, ``config_overview.rst:403-409``).  The
+    replication is a GSPMD-level ``jnp.repeat`` so gradient accumulation over
+    the sharing TP ranks is XLA's job.
     """
     mesh = mesh or shd.active_mesh()
     cp = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     if cp == 1:
         from neuronx_distributed_training_tpu.ops.attention import core_attention
 
-        return core_attention(q, k, v, causal=causal)
+        return core_attention(q, k, v, causal=causal, sliding_window=sliding_window)
 
     h, kvh = q.shape[2], k.shape[2]
     tp = int(mesh.shape.get("model", 1))
-    if tp > 1 and (h % tp != 0 or kvh % tp != 0):
-        # Per-rank GQA head mapping inside shard_map requires both head counts
-        # to divide tp (replicated KV with sharded Q would misalign the q->kv
-        # group mapping rank-locally).  Fall back to GSPMD core attention —
-        # correct, just without the ring (the reference's kv_shared_group_size
-        # replication trick is a later optimization).
-        from neuronx_distributed_training_tpu.ops.attention import core_attention
-
-        return core_attention(q, k, v, causal=causal)
+    if tp > 1:
+        if h % tp != 0:
+            raise ValueError(
+                f"ring attention: num_heads {h} must be divisible by tp {tp}"
+            )
+        if kvh % tp != 0:
+            if tp % kvh != 0:
+                raise ValueError(
+                    f"ring attention: kv_heads {kvh} and tp {tp} must divide "
+                    f"one another (got kvh%tp and tp%kvh both nonzero)"
+                )
+            # kv replication: [.., kvh, d] -> [.., tp, d]; head j of the
+            # replicated array is original head j // (tp // kvh), so rank r's
+            # local kv head is exactly the group its q heads [r*h/tp, ...)
+            # belong to (see docstring)
+            mult = tp // kvh
+            k = jnp.repeat(k, mult, axis=2)
+            v = jnp.repeat(v, mult, axis=2)
     q_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
     kv_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
 
     body = functools.partial(
-        _ring_local, axis_name=axis_name, cp=cp, causal=causal
+        _ring_local, axis_name=axis_name, cp=cp, causal=causal,
+        window=sliding_window, block_kv=block_kv,
     )
     fn = jax.shard_map(
         body,

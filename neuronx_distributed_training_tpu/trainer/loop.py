@@ -89,14 +89,31 @@ class Trainer:
         mesh = build_mesh(mesh_cfg, devices=devices)
         policy = DtypePolicy.from_precision_config(cfg.get("precision", {}))
         sched = batch_schedule(cfg, len(devices))
-
-        model_cfg, loss_fn, init_fn, specs_fn = build_model(cfg, policy)
         seed = int(cfg.get("seed", 1234))
+
+        # data first: the module's label convention decides shift_labels
+        # (reference training.py:71-91 selects the DataModule the same way)
+        from neuronx_distributed_training_tpu.data.build import (
+            alignment_strategy,
+            build_data_module,
+        )
+
+        alignment, align_params = alignment_strategy(cfg)
+        if data_module is None:
+            data_module, cfg_val_dm = build_data_module(cfg, sched, seed=seed)
+            if val_data_module is None:
+                val_data_module = cfg_val_dm
+        # Megatron mmap data is pre-shifted on host (gpt_dataset_patch
+        # convention); everything else relies on the in-model shift
+        shift_labels = not getattr(data_module, "labels_pre_shifted", False)
+
+        model_cfg, loss_fn, init_fn, specs_fn = build_model(
+            cfg, policy, shift_labels=shift_labels
+        )
         params = init_fn(jax.random.PRNGKey(seed))
 
         # DPO swaps the loss for the preference objective; the pre-fit
         # reference-logprob pass runs in fit() (reference base_dpo.py:23-66)
-        alignment = str(cfg.get("model_alignment_strategy", "") or "").lower()
         if alignment == "dpo":
             from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
 
@@ -109,9 +126,9 @@ class Trainer:
                 out, _ = llama.forward(p, {"input_ids": batch["input_ids"]}, mc_ref, policy)
                 return out
 
-            loss_fn = make_dpo_loss_fn(
-                forward_logits, beta=float(dpo_cfg.get("beta", 0.1))
-            )
+            # reference spells it kl_beta in the strategy block
+            beta = float(align_params.get("kl_beta", dpo_cfg.get("beta", 0.1)))
+            loss_fn = make_dpo_loss_fn(forward_logits, beta=beta)
 
         # LoRA: inject adapters + freeze base weights (reference
         # llama_model.py:51-65 -> nxd lora_config)
@@ -154,7 +171,7 @@ class Trainer:
             vp = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
             # fail early with a clear message instead of an opaque GSPMD error
             stage_layer_slice(int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
-            hooks = pipeline_hooks_for(cfg, model_cfg, policy)
+            hooks = pipeline_hooks_for(cfg, model_cfg, policy, shift_labels=shift_labels)
             nm = sched["num_microbatches"]
             embed_fn, stage_fn, stage_loss_fn = hooks
 
@@ -214,7 +231,23 @@ class Trainer:
         params = put(params, pspecs)
         opt_state = put(opt_state, ospecs)
 
+        # warm start: weights only, no optimizer/loop state (the reference's
+        # weight_init_only + resume_from_checkpoint SFT/DPO recipe,
+        # nlp_overrides.py:541-568)
+        warm_path = (cfg.get("exp_manager", {}) or {}).get("resume_from_checkpoint")
+        if warm_path and bool((cfg.get("model", {}) or {}).get("weight_init_only")):
+            warm_ck = Checkpointer(CheckpointConfig(dir=str(warm_path)))
+            try:
+                params = warm_ck.restore_params_only(
+                    params, mesh=mesh, param_specs=pspecs
+                )
+            finally:
+                warm_ck.close()
+            logger.info("warm start: params restored from %s", warm_path)
+
         if data_module is None:
+            # deferred ``data.synthetic: true`` (build_data_module had no vocab
+            # hint before the model existed); any other source was built above
             seq = int((cfg.get("data", {}) or {}).get("seq_length", 2048))
             data_module = SyntheticDataModule(
                 vocab_size=model_cfg.vocab_size,
@@ -234,12 +267,30 @@ class Trainer:
         if alignment == "dpo":
             def pre_fit(trainer: "Trainer") -> None:
                 """Frozen-policy reference-logprob pass + column attach
-                (reference base_dpo.py:23-66 on_train_start)."""
+                (reference base_dpo.py:23-66 on_train_start).
+
+                Runs BEFORE checkpoint resume (fit() ordering): the reference
+                logps must come from the frozen INITIAL policy, and at that
+                point ``trainer.params`` still hold the deterministic initial
+                (or warm-start) weights the original run started from.  The
+                columns are cached to a sidecar so resumes skip the pass."""
                 dm = trainer.data_module
                 if not hasattr(dm, "attach_reference_logprobs"):
                     return  # caller supplied reference columns already
                 if "reference_chosen_logps" in getattr(dm, "arrays", {}):
                     return
+                import os
+
+                sidecar = None
+                if trainer.checkpointer is not None:
+                    sidecar = os.path.join(
+                        str(trainer.checkpointer.config.dir), "dpo_reference_logps.npz"
+                    )
+                    if os.path.exists(sidecar):
+                        loaded = np.load(sidecar)
+                        dm.attach_reference_logprobs({k: loaded[k] for k in loaded.files})
+                        logger.info("DPO reference logps restored from %s", sidecar)
+                        return
                 from neuronx_distributed_training_tpu.alignment.dpo import (
                     compute_reference_logprobs,
                 )
@@ -258,6 +309,9 @@ class Trainer:
                     extra = compute_reference_logprobs(trainer.params, [rem], forward_logits)
                     cols = {k: np.concatenate([cols[k], extra[k]]) for k in cols}
                 dm.attach_reference_logprobs(cols)
+                if sidecar is not None:
+                    os.makedirs(os.path.dirname(sidecar), exist_ok=True)
+                    np.savez(sidecar, **cols)
 
         return cls(
             cfg=cfg, mesh=mesh, policy=policy, model_cfg=model_cfg, loss_fn=loss_fn,
@@ -296,9 +350,11 @@ class Trainer:
             self.checkpointer.config.every_n_train_steps if self.checkpointer else 0
         )
 
-        self.maybe_resume()
-        if self.pre_fit is not None and self.step == 0:
+        # pre_fit BEFORE resume: the DPO reference pass must see the frozen
+        # initial policy, not resumed weights (see pre_fit docstring)
+        if self.pre_fit is not None:
             self.pre_fit(self)
+        self.maybe_resume()
         last_metrics: dict[str, float] = {}
         batches = self.data_module.sharded_batches(self.mesh)
         try:
@@ -359,11 +415,12 @@ class Trainer:
         )
 
 
-def build_model(cfg: ConfigDict, policy: DtypePolicy):
+def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = True):
     """Model dispatch by ``model_source`` + architecture (reference
     ``training.py:71-91`` selects Megatron vs HF modules the same way).
 
-    Returns ``(model_cfg, loss_fn, init_fn, specs_fn)``.
+    ``shift_labels=False`` when the data path pre-shifts on host (the Megatron
+    mmap convention).  Returns ``(model_cfg, loss_fn, init_fn, specs_fn)``.
     """
     source = str(cfg.get("model_source", "hf")).lower()
     if source not in ("hf", "megatron"):
@@ -376,7 +433,7 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy):
         mc = llama.LlamaConfig.from_config(model_block, ds_block)
 
         def loss_fn(p, batch, key):
-            return llama.forward(p, batch, mc, policy)
+            return llama.forward(p, batch, mc, policy, shift_labels=shift_labels)
 
         return (
             mc,
@@ -390,7 +447,7 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy):
         xc = mixtral.MixtralConfig.from_config(model_block, ds_block)
 
         def loss_fn(p, batch, key):
-            return mixtral.forward(p, batch, xc, policy)
+            return mixtral.forward(p, batch, xc, policy, shift_labels=shift_labels)
 
         return (
             xc,
@@ -404,7 +461,7 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy):
         gc = gpt.GPTConfig.from_config(model_block, ds_block)
 
         def loss_fn(p, batch, key):
-            return gpt.forward(p, batch, gc, policy, rng=key)
+            return gpt.forward(p, batch, gc, policy, rng=key, shift_labels=shift_labels)
 
         return (
             gc,
@@ -415,10 +472,11 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy):
     raise ValueError(f"unsupported model_source/architecture: {source}/{arch}")
 
 
-def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy):
+def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
+                       *, shift_labels: bool = True):
     """Pipeline hooks dispatch (llama-family only so far)."""
     if isinstance(model_cfg, llama.LlamaConfig):
-        return llama.pipeline_hooks(model_cfg, policy)
+        return llama.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels)
     raise NotImplementedError(
         f"pipeline parallelism not wired for {type(model_cfg).__name__} yet"
     )

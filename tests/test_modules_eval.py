@@ -120,6 +120,34 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
         np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompts))
 
+    def test_ragged_batch_right_padding_matches_solo(self):
+        """A short prompt in a batch with a longer one must generate exactly
+        what it generates alone — right-padding + per-row fronts means pads
+        are never attended and RoPE positions are unshifted."""
+        from neuronx_distributed_training_tpu.models.generate import pad_prompts
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=1,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        )
+        policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(1), cfg, policy)
+
+        def logits_of(p, ids):
+            out, _ = llama.forward(p, {"input_ids": ids}, cfg, policy)
+            return out
+
+        short, long = [5, 6], [9, 10, 11, 12, 13, 14]
+        ids, lens = pad_prompts([short, long])
+        both = generate(params, ids, lens, logits_of, max_new_tokens=4, eos_id=1)
+        solo_ids, solo_lens = pad_prompts([short])
+        solo = generate(params, solo_ids, solo_lens, logits_of,
+                        max_new_tokens=4, eos_id=1)
+        np.testing.assert_array_equal(
+            np.asarray(both[0, 2:6]), np.asarray(solo[0, 2:6])
+        )
+
 
 class TestEvalMetrics:
     def test_rouge_l(self):

@@ -95,6 +95,91 @@ class TestRingNumerics:
         ref = core_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_kv_replication_tp_exceeds_kv_heads(self, devices8):
+        """tp=4 > kv_heads=2: the kv_shared_group_size replication path
+        (reference modeling_llama.py:310-320) — the 70B CP config shape class
+        (tp=32, 8 kv heads).  Must run the actual ring, not a fallback."""
+        mesh = build_mesh(
+            MeshConfig(context_parallel_size=2, tensor_model_parallel_size=4)
+        )
+        q, k, v = make_qkv(jax.random.PRNGKey(8), h=8, kvh=2, s=32)
+        ref = core_attention(q, k, v, causal=True)
+        with mesh, shd.use_mesh(mesh):
+            out = jax.jit(lambda *a: ring_attention(*a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_kv_replication_grads(self, devices8):
+        """Gradients flow correctly through the replicated KV heads (XLA sums
+        the replica contributions back onto the original heads)."""
+        mesh = build_mesh(
+            MeshConfig(context_parallel_size=2, tensor_model_parallel_size=4)
+        )
+        q, k, v = make_qkv(jax.random.PRNGKey(9), h=8, kvh=2, s=32)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.square(ring_attention(q, k, v, causal=True)))
+
+        def loss_core(q, k, v):
+            return jnp.sum(jnp.square(core_attention(q, k, v, causal=True)))
+
+        ref_grads = jax.grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        with mesh, shd.use_mesh(mesh):
+            grads = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4)
+
+    def test_incompatible_heads_raise(self, devices8):
+        """No silent fallback: head counts that divide neither way are an
+        error, not quiet O(s^2) core attention."""
+        mesh = build_mesh(
+            MeshConfig(context_parallel_size=2, tensor_model_parallel_size=4)
+        )
+        q, k, v = make_qkv(jax.random.PRNGKey(10), h=8, kvh=3, s=32)
+        with mesh, shd.use_mesh(mesh):
+            with pytest.raises(ValueError, match="divide"):
+                ring_attention(q, k, v)
+
+    def test_sliding_window(self, cp_mesh):
+        """Sliding-window masking with global ring offsets (the Mixtral
+        use_sliding_window case ops.attention previously dropped)."""
+        q, k, v = make_qkv(jax.random.PRNGKey(11), s=64)
+        ref = core_attention(q, k, v, causal=True, sliding_window=16)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(
+                lambda *a: ring_attention(*a, causal=True, sliding_window=16)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_blockwise_inner_matches(self, cp_mesh):
+        """block_kv smaller than the chunk: the flash-style inner tiling must
+        not change numerics."""
+        q, k, v = make_qkv(jax.random.PRNGKey(12), s=128)
+        ref = core_attention(q, k, v, causal=True)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(
+                lambda *a: ring_attention(*a, causal=True, block_kv=8)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_ring_dispatch_rejects_q_offset(self, cp_mesh):
+        from neuronx_distributed_training_tpu.ops.attention import attention
+
+        q, k, v = make_qkv(jax.random.PRNGKey(13), s=32)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            with pytest.raises(ValueError, match="q_offset"):
+                attention(q, k, v, impl="ring", q_offset=4)
+
+    def test_ring_dispatch_passes_sliding_window(self, cp_mesh):
+        from neuronx_distributed_training_tpu.ops.attention import attention
+
+        q, k, v = make_qkv(jax.random.PRNGKey(14), s=64)
+        ref = core_attention(q, k, v, causal=True, sliding_window=16)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(
+                lambda *a: attention(*a, impl="ring", sliding_window=16)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
     def test_bf16(self, cp_mesh):
         q, k, v = make_qkv(jax.random.PRNGKey(7), dtype=jnp.bfloat16)
         ref = core_attention(q, k, v, causal=True)

@@ -22,7 +22,8 @@ def tiny_cfg(tmp_path, max_steps=5, **over):
             "checkpoint_callback_params": {"save_top_k": 2, "every_n_train_steps": 2},
         },
         "distributed_strategy": {"tensor_model_parallel_size": 2, "sequence_parallel": True},
-        "data": {"global_batch_size": 8, "micro_batch_size": 1, "seq_length": 32},
+        "data": {"global_batch_size": 8, "micro_batch_size": 1, "seq_length": 32,
+                 "synthetic": True},
         "model": {
             "vocab_size": 128,
             "hidden_size": 64,
