@@ -281,18 +281,25 @@ def main() -> None:
         cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp)
         log(f"bench[{name}]: device={dev.device_kind} layers={cfg.num_layers} "
             f"seq={seq} mbs={args.mbs} attn={cfg.attention_impl}")
-        tries = [cfg.num_layers]
-        if cfg.num_layers > 1:
-            tries.append(max(1, cfg.num_layers // 2))  # OOM backoff
-        for n_layers in tries:
+        # OOM backoff: fewer layers, then tied embed+head (halves the 1.05B
+        # vocab params — the mixed-precision regime's fp32 master+opt state
+        # for untied 128256-vocab embeddings alone overflows a 16G chip)
+        tries: list[tuple[int, bool]] = []
+        for tied in (False, True):
+            for n in (cfg.num_layers, max(1, cfg.num_layers // 2)):
+                if (n, tied) not in tries:
+                    tries.append((n, tied))
+        for n_layers, tied in tries:
             try:
-                if n_layers != cfg.num_layers:
+                if n_layers != cfg.num_layers or tied:
                     import dataclasses as _dc
 
-                    cfg = _dc.replace(cfg, num_layers=n_layers)
-                    log(f"bench[{name}]: retrying with layers={n_layers}")
+                    cfg = _dc.replace(
+                        cfg, num_layers=n_layers, tie_word_embeddings=tied)
+                    log(f"bench[{name}]: retrying layers={n_layers} tied={tied}")
                 results[name] = run_bench(
                     dev, cfg, policy, seq, args.mbs, steps, warmup)
+                results[name]["tied_embeddings"] = tied
                 errors.pop(name, None)  # a successful backoff clears the record
                 break
             except Exception as e:  # noqa: BLE001 — keep the other regime alive
@@ -323,6 +330,7 @@ def main() -> None:
         "device": dev.device_kind,
         "attn_impl": attn_impl,
         "num_layers": r["num_layers"],
+        "tied_embeddings": r.get("tied_embeddings", False),
         "seq_len": seq,
         "note": "layer count scaled to single-chip HBM; MFU is per-layer-shape-bound",
     }

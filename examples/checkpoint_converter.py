@@ -22,15 +22,50 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _load_nnm_state(path: str, tp: int, pp: int, num_layers: int, glu: bool):
+    """Load a NeMo-Megatron checkpoint: either a single state-dict file or the
+    rank-sharded ``tp_rank_XX_pp_rank_XXX/model_optim_rng.ckpt`` layout the
+    reference converter walks (``nnm_model_ckpt_to_nxdt...py:88-111``)."""
+    from neuronx_distributed_training_tpu.tools import convert, convert_megatron
+
+    p = Path(path)
+    if p.is_file():
+        return convert.load_torch_state_dict(str(p))
+    shards = {}
+    for r in range(tp):
+        for s in range(pp):
+            name = (f"tp_rank_{r:02d}_pp_rank_{s:03d}" if pp > 1
+                    else f"mp_rank_{r:02d}")
+            ck = p / name / "model_optim_rng.ckpt"
+            if not ck.exists():
+                ck = p / name / "model_weights.ckpt"
+            import torch
+
+            sd = torch.load(str(ck), map_location="cpu", weights_only=False)
+            sd = sd.get("state_dict", sd)
+            shards[(r, s)] = {
+                k: v.float().numpy() for k, v in sd.items() if hasattr(v, "numpy")
+            }
+    return convert_megatron.merge_nnm_shards(
+        shards, tp=tp, pp=pp, num_layers=num_layers, glu=glu
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", choices=["llama", "mixtral"], default="llama")
-    ap.add_argument("--direction", choices=["hf2native", "native2hf"], required=True)
+    ap.add_argument("--model", choices=["llama", "mixtral", "gpt"], default="llama")
+    ap.add_argument("--direction",
+                    choices=["hf2native", "native2hf", "nnm2native", "native2nnm"],
+                    required=True)
     ap.add_argument("--config", required=True, help="YAML config (reference schema)")
     ap.add_argument("--input", required=True)
     ap.add_argument("--output", required=True)
     ap.add_argument("--step", type=int, default=0,
                     help="checkpoint step number to write/read (native side)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="TP degree of a sharded NNM checkpoint dir")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="PP degree of a sharded NNM checkpoint dir")
     args = ap.parse_args()
 
     import jax
@@ -44,7 +79,14 @@ def main() -> None:
     model_block = dict(cfg_yaml.get("model", {}) or {})
     ds_block = dict(cfg_yaml.get("distributed_strategy", {}) or {})
 
-    if args.model == "llama":
+    if args.direction in ("nnm2native", "native2nnm") or args.model == "gpt":
+        from neuronx_distributed_training_tpu.models import gpt as gpt_mod
+        from neuronx_distributed_training_tpu.tools import convert_megatron
+
+        cfg = gpt_mod.GPTConfig.from_config(model_block, ds_block)
+        to_native = lambda sd: convert_megatron.megatron_gpt_to_native(sd, cfg)
+        to_hf = lambda p: convert_megatron.native_to_megatron_gpt(p, cfg)
+    elif args.model == "llama":
         cfg = llama_mod.LlamaConfig.from_config(model_block, ds_block)
         to_native = lambda sd: convert.hf_llama_to_native(sd, cfg)
         to_hf = lambda p: convert.native_to_hf_llama(p, cfg)
@@ -56,8 +98,16 @@ def main() -> None:
         to_hf = None  # native->hf mixtral: not yet implemented
 
     out = Path(args.output)
-    if args.direction == "hf2native":
-        state = convert.load_torch_state_dict(args.input)
+    if args.direction in ("hf2native", "nnm2native"):
+        if args.direction == "nnm2native":
+            state = _load_nnm_state(
+                args.input, args.tp, args.pp,
+                num_layers=int(model_block.get("num_layers", 12)),
+                glu=str(model_block.get("activation", "gelu")) in
+                    ("swiglu", "geglu", "reglu"),
+            )
+        else:
+            state = convert.load_torch_state_dict(args.input)
         params = to_native(state)
         with ocp.CheckpointManager(out.absolute()) as mgr:
             mgr.save(args.step, args=ocp.args.Composite(
@@ -66,7 +116,7 @@ def main() -> None:
         print(f"wrote native checkpoint: {out}/{args.step}/params")
     else:
         if to_hf is None:
-            raise SystemExit("native2hf for mixtral not yet implemented")
+            raise SystemExit(f"{args.direction} for {args.model} not yet implemented")
         with ocp.CheckpointManager(Path(args.input).absolute()) as mgr:
             step = args.step or mgr.latest_step()
             restored = mgr.restore(step, args=ocp.args.Composite(

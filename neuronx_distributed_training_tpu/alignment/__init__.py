@@ -7,4 +7,8 @@ from neuronx_distributed_training_tpu.alignment.losses import (  # noqa: F401
 )
 from neuronx_distributed_training_tpu.alignment.dpo import (  # noqa: F401
     compute_reference_logprobs,
+    make_dpo_loss_fn,
+)
+from neuronx_distributed_training_tpu.alignment.orpo import (  # noqa: F401
+    make_orpo_loss_fn,
 )

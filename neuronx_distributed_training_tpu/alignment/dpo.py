@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_training_tpu.alignment.losses import dpo_loss, sequence_logprobs
@@ -54,6 +55,67 @@ def compute_reference_logprobs(
         "reference_chosen_logps": np.concatenate(chosen),
         "reference_rejected_logps": np.concatenate(rejected),
     }
+
+
+def preference_pipeline_hooks(embed_fn, stage_fn, head_fn, *, mode: str = "dpo",
+                              beta: float = 0.1):
+    """Wrap a model's pipeline hooks for DPO/ORPO under pipeline parallelism.
+
+    The reference runs preference losses through NxDPPModel via the
+    "concatenated forward" (``base_dpo.py:68-88`` stacks chosen+rejected into
+    one batch so the pipelined model runs once).  Same trick here: the embed
+    hook concatenates ``chosen_input_ids``/``rejected_input_ids`` along batch,
+    the stages run the doubled microbatch, and the loss hook splits the final
+    hidden states to compute per-sequence log-probs and the preference loss.
+    ``head_fn(params, hidden) -> logits`` is the model's final-norm + lm-head.
+
+    Returns hooks with the standard ``(loss_sum, denom)`` contract
+    (pair-count-weighted so microbatch accumulation averages over pairs).
+    """
+    from neuronx_distributed_training_tpu.alignment.losses import (
+        dpo_loss,
+        orpo_loss,
+    )
+
+    def cat(mb):
+        ids = jnp.concatenate(
+            [mb["chosen_input_ids"], mb["rejected_input_ids"]], axis=0
+        )
+        out = {"input_ids": ids}
+        for k in ("_rng", "_chunk"):
+            if k in mb:
+                out[k] = mb[k]
+        return out
+
+    def embed2(params, mb):
+        return embed_fn(params, cat(mb))
+
+    def stage2(local_layers, x, mb):
+        return stage_fn(local_layers, x, cat(mb))
+
+    def loss2(params, y, mb):
+        logits = head_fn(params, y)
+        b = mb["chosen_input_ids"].shape[0]
+        avg = mode == "orpo"
+        pc = sequence_logprobs(
+            logits[:b], mb["chosen_input_ids"], mb.get("chosen_loss_mask"),
+            average=avg,
+        )
+        pr = sequence_logprobs(
+            logits[b:], mb["rejected_input_ids"], mb.get("rejected_loss_mask"),
+            average=avg,
+        )
+        if mode == "dpo":
+            loss, _ = dpo_loss(
+                pc, pr,
+                mb["reference_chosen_logps"], mb["reference_rejected_logps"],
+                beta=beta,
+            )
+        else:
+            loss, _ = orpo_loss(pc, pr, -jnp.mean(pc), beta=beta)
+        return loss * b, jnp.asarray(b, jnp.float32)
+
+    return embed2, stage2, loss2
 
 
 def make_dpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):

@@ -1,0 +1,49 @@
+"""ORPO loss adapter — reference-model-free preference optimization.
+
+The reference's ORPO recipe (``base_orpo.py:26-46``) reuses the DPO
+concatenated-forward machinery but needs NO frozen reference policy: the loss
+is ``NLL(chosen) + beta * (-logsigmoid(log_odds))`` where the log-odds ratio
+is computed from length-AVERAGED policy log-probs alone.  That makes the
+trainer wiring strictly simpler than DPO — no pre-fit pass, no sidecar
+columns — and it consumes the same DPO-shaped batches
+(``chosen_input_ids``/``rejected_input_ids`` + loss masks).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.alignment.dpo import ForwardLogits
+from neuronx_distributed_training_tpu.alignment.losses import (
+    orpo_loss,
+    sequence_logprobs,
+)
+
+
+def make_orpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):
+    """Build a trainer-compatible loss_fn for ORPO batches.
+
+    Batch contract: ``chosen_input_ids``/``rejected_input_ids`` (+ optional
+    ``*_loss_mask``).  Unlike DPO there are no reference columns.
+    """
+
+    def loss_fn(params, batch, _key):
+        pc = sequence_logprobs(
+            forward_logits(params, {"input_ids": batch["chosen_input_ids"]}),
+            batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
+            average=True,
+        )
+        pr = sequence_logprobs(
+            forward_logits(params, {"input_ids": batch["rejected_input_ids"]}),
+            batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
+            average=True,
+        )
+        # reference base_orpo.py:33 — the chosen NLL term is the mean of the
+        # length-averaged chosen log-probs, negated
+        nll = -jnp.mean(pc)
+        loss, metrics = orpo_loss(pc, pr, nll, beta=beta)
+        metrics["rewards_chosen"] = beta * jnp.mean(pc)
+        metrics["rewards_rejected"] = beta * jnp.mean(pr)
+        return loss, metrics
+
+    return loss_fn

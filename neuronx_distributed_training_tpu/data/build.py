@@ -109,9 +109,12 @@ def build_data_module(
     max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 1000))
 
     if strategy in ("sft",):
+        from neuronx_distributed_training_tpu.data.templates import build_template
+
         tokenizer = build_tokenizer(data)
         packing = bool(strat_params.get("packing", True))
         n_head = data.get("dev_choose_samples")
+        template = build_template(data, tokenizer)
 
         def sft(path):
             from neuronx_distributed_training_tpu.data.modules import (
@@ -123,6 +126,7 @@ def build_data_module(
                 records = records[: int(n_head)]
             return SFTDataModule(
                 records, tokenizer, seq, gbs, packing=packing, seed=seed,
+                template=template,
             )
 
         if not train_dir:

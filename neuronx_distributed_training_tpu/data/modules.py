@@ -100,6 +100,7 @@ class SFTDataModule(DataModule):
         bos_id: Optional[int] = None,
         eos_id: Optional[int] = None,
         pad_id: int = 0,
+        template: Optional[Any] = None,  # data.templates.Template
         **kw: Any,
     ):
         if isinstance(records, (str, Path)):
@@ -112,6 +113,10 @@ class SFTDataModule(DataModule):
 
         ids_list, lbl_list = [], []
         for r in records:
+            if template is not None:
+                # prompt-template pass before tokenization (reference
+                # model_alignment_data_module.py:94-121 prompt_datasets)
+                r = template(r)
             src = r.get("input", r.get("prompt", ""))
             dst = r.get("output", r.get("completion", ""))
             # bos+src / dst+eos split (reference :148-160)

@@ -325,6 +325,108 @@ def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key):
     return x, aux_loss
 
 
+def _rope_for(cfg: GPTConfig, input_ids: jax.Array):
+    if cfg.position_embedding_type == "learned_absolute":
+        return None, None
+    b, s = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    rot_dim = int(cfg.head_size * cfg.rotary_percentage) // 2 * 2
+    inv_freq = rope_ops.rope_frequencies(rot_dim, theta=cfg.rope_theta)
+    return rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
+
+
+def _logits_from_hidden(params, hidden, cfg: GPTConfig, policy: DtypePolicy):
+    if cfg.share_embeddings_and_output_weights:
+        w = params["embed"]["embedding"].astype(policy.compute_dtype)
+        logits = hidden @ w.T
+    else:
+        logits = linear_ops.apply_linear(
+            params["lm_head"], hidden, compute_dtype=policy.compute_dtype
+        )
+    return shd.constrain(logits, shd.logits_spec(False))
+
+
+def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = True):
+    """(embed_fn, stage_fn, loss_fn) for ``parallel.pipeline.pipeline_loss``.
+
+    Dropout PRNG: the trainer threads per-microbatch keys via ``mb["_rng"]``
+    (uint32 ``[2]`` leaves); each stage folds in its pipe rank and vp chunk
+    (``mb["_chunk"]``) so every (layer, microbatch) pair gets a unique key —
+    the reference's per-stage dropout seeding under NxDPPModel.  ``stage_fn``
+    returns ``(x, aux)``; pass ``stage_aux=True`` (aux is the MoE router loss,
+    0 for dense).
+    """
+    aspec = shd.act_spec(cfg.sequence_parallel, False)
+
+    def embed_fn(params, mb):
+        ids = mb["input_ids"]
+        s = ids.shape[1]
+        x = linear_ops.apply_embedding(
+            params["embed"], ids, compute_dtype=policy.compute_dtype,
+            via_matmul=True,
+        )
+        if cfg.position_embedding_type == "learned_absolute":
+            x = x + jnp.take(
+                params["pos_embed"]["embedding"], jnp.arange(s), axis=0
+            ).astype(x.dtype)[None]
+        rng = mb.get("_rng")
+        if rng is not None and cfg.embedding_dropout > 0.0:
+            x = _dropout(x, cfg.embedding_dropout, jax.random.fold_in(rng, 0x0E))
+        return shd.constrain(x, aspec)
+
+    def stage_fn(local_layers, x, mb):
+        cos, sin = _rope_for(cfg, mb["input_ids"])
+        local_layers = policy.cast_to_compute(local_layers)
+        n_local = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
+        rng = mb.get("_rng")
+        if rng is not None and cfg.hidden_dropout > 0.0:
+            try:
+                rank = jax.lax.axis_index("pipe")
+            except NameError:
+                rank = 0  # pp == 1 fallback path (no manual pipe axis)
+            stage_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, rank), mb.get("_chunk", 0)
+            )
+            layer_keys = jax.random.split(stage_rng, n_local)
+
+            def body(carry, inp):
+                x, aux_acc = carry
+                lp, lkey = inp
+                x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey)
+                return (x, aux_acc + aux), None
+
+            xs = (local_layers, layer_keys)
+        else:
+
+            def body(carry, lp):
+                x, aux_acc = carry
+                x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, None)
+                return (x, aux_acc + aux), None
+
+            xs = local_layers
+        (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
+        return x, aux_sum
+
+    def loss_fn(params, y, mb):
+        hidden = _apply_norm(cfg, params["final_norm"], y)
+        logits = _logits_from_hidden(params, hidden, cfg, policy)
+        labels = mb["labels"]
+        loss_mask = mb.get("loss_mask")
+        if shift_labels:
+            logits, labels, loss_mask = ce_ops.shift_for_next_token(
+                logits, labels, loss_mask
+            )
+        loss_sum = ce_ops.cross_entropy_loss(
+            logits, labels, loss_mask=loss_mask, reduction="sum"
+        )
+        valid = (labels != -100).astype(jnp.float32)
+        if loss_mask is not None:
+            valid = valid * loss_mask.astype(jnp.float32)
+        return loss_sum, jnp.sum(valid)
+
+    return embed_fn, stage_fn, loss_fn
+
+
 def forward(
     params,
     batch: dict[str, jax.Array],
@@ -343,16 +445,10 @@ def forward(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
     if cfg.position_embedding_type == "learned_absolute":
-        pos = jnp.arange(s)
-        x = x + jnp.take(params["pos_embed"]["embedding"], pos, axis=0).astype(
-            x.dtype
-        )[None]
-        cos = sin = None
-    else:
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
-        rot_dim = int(cfg.head_size * cfg.rotary_percentage) // 2 * 2
-        inv_freq = rope_ops.rope_frequencies(rot_dim, theta=cfg.rope_theta)
-        cos, sin = rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
+        x = x + jnp.take(
+            params["pos_embed"]["embedding"], jnp.arange(s), axis=0
+        ).astype(x.dtype)[None]
+    cos, sin = _rope_for(cfg, input_ids)
     if rng is not None:
         rng, kemb = jax.random.split(rng)
         x = _dropout(x, cfg.embedding_dropout, kemb)
@@ -380,15 +476,7 @@ def forward(
     xs = (layer_stack, layer_keys) if layer_keys is not None else layer_stack
     (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
     hidden = _apply_norm(cfg, params["final_norm"], x)
-
-    if cfg.share_embeddings_and_output_weights:
-        w = params["embed"]["embedding"].astype(policy.compute_dtype)
-        logits = hidden @ w.T
-    else:
-        logits = linear_ops.apply_linear(
-            params["lm_head"], hidden, compute_dtype=policy.compute_dtype
-        )
-    logits = shd.constrain(logits, shd.logits_spec(False))
+    logits = _logits_from_hidden(params, hidden, cfg, policy)
 
     aux: dict[str, Any] = {}
     if cfg.moe is not None:

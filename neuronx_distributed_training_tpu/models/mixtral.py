@@ -129,6 +129,60 @@ def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy):
     return shd.constrain(residual + hidden, aspec), aux_loss
 
 
+def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
+                   shift_labels: bool = True):
+    """(embed_fn, stage_fn, loss_fn) for ``parallel.pipeline.pipeline_loss``.
+
+    ``stage_fn`` returns ``(x, aux)`` (use ``stage_aux=True``): the router
+    aux-loss accumulates per stage and crosses pipe ranks as a psum'd scalar —
+    the TPU-native form of the reference threading ``past_router_logits``
+    through pipeline stages (``modeling_mixtral.py:440-549``).  The caller
+    scales the psum'd total by ``1 / (num_microbatches * num_layers)``.
+    """
+    lc = cfg.llama
+    aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
+
+    def embed_fn(params, mb):
+        x = linear_ops.apply_embedding(
+            params["embed"], mb["input_ids"], compute_dtype=policy.compute_dtype,
+            via_matmul=True,
+        )
+        return shd.constrain(x, aspec)
+
+    def stage_fn(local_layers, x, mb):
+        cos, sin = llama._rope_for(mb["input_ids"], lc)
+        local_layers = policy.cast_to_compute(local_layers)
+
+        def body(carry, lp):
+            x, aux_acc = carry
+            x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
+            return (x, aux_acc + aux), None
+
+        (x, aux_sum), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), local_layers
+        )
+        return x, aux_sum
+
+    def loss_fn(params, y, mb):
+        h = norm_ops.apply_rms_norm(params["final_norm"], y, eps=lc.rms_norm_eps)
+        logits = llama.logits_fn(params, h, lc, policy)
+        labels = mb["labels"]
+        loss_mask = mb.get("loss_mask")
+        if shift_labels:
+            logits, labels, loss_mask = ce_ops.shift_for_next_token(
+                logits, labels, loss_mask
+            )
+        loss_sum = ce_ops.cross_entropy_loss(
+            logits, labels, loss_mask=loss_mask, reduction="sum"
+        )
+        valid = (labels != -100).astype(jnp.float32)
+        if loss_mask is not None:
+            valid = valid * loss_mask.astype(jnp.float32)
+        return loss_sum, jnp.sum(valid)
+
+    return embed_fn, stage_fn, loss_fn
+
+
 def forward(
     params,
     batch: dict[str, jax.Array],

@@ -99,8 +99,23 @@ def init_embedding(
     return params, specs
 
 
-def apply_embedding(params, ids: jax.Array, *, compute_dtype=None) -> jax.Array:
+def apply_embedding(params, ids: jax.Array, *, compute_dtype=None,
+                    via_matmul: bool = False) -> jax.Array:
+    """Embedding lookup.
+
+    ``via_matmul`` computes ``one_hot(ids) @ table`` instead of a gather: the
+    backward pass is then a ``dot_general`` rather than a scatter-add.  Used by
+    the pipeline hooks — XLA's SPMD partitioner CHECK-crashes partitioning the
+    gather-transpose scatter when its consumer is DP-resharded (ZeRO-1 moments)
+    inside the manual ``pipe`` submesh (spmd_partitioner_util.cc:495).  With a
+    TP-sharded table the contraction form is also exactly Megatron's
+    vocab-parallel embedding (mask-local-vocab + all-reduce), done by GSPMD.
+    """
     table = params["embedding"]
+    if via_matmul:
+        dtype = compute_dtype or table.dtype
+        oh = jax.nn.one_hot(ids, table.shape[0], dtype=dtype)
+        return oh @ table.astype(dtype)
     out = jnp.take(table, ids, axis=0)
     if compute_dtype is not None:
         out = out.astype(compute_dtype)

@@ -206,11 +206,10 @@ def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
     """Spec pytree matching ``init_opt_state`` output.
 
     ``zero1_exclude`` names path substrings whose moments keep the plain param
-    spec (no DP sharding).  Needed for the embedding under pipeline parallelism:
-    XLA's SPMD partitioner CHECK-crashes partitioning the embedding-grad
-    scatter when its consumer is DP-resharded inside the manual ``pipe``
-    submesh (spmd_partitioner_util.cc ExpandDeviceGroupsWithIota) — excluding
-    the embedding sidesteps the compiler bug at negligible memory cost."""
+    spec (no DP sharding) — a generic escape hatch; nothing in the stock
+    models needs it (the former embedding-under-PP exclusion was removed by
+    switching the pipeline embed hooks to the one-hot matmul form, see
+    ``ops.linear.apply_embedding``)."""
     policy = policy or DtypePolicy()
 
     if zero1:

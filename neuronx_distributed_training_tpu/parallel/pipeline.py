@@ -43,8 +43,13 @@ from neuronx_distributed_training_tpu.parallel import sharding as shd
 PIPE_AXIS = "pipe"
 
 # EmbedFn:    (params, microbatch_dict) -> activations [mb, s, h]
-# StageFn:    (local_layer_params, activations, microbatch_dict) -> activations
+# StageFn:    (local_layer_params, activations, microbatch_dict) -> activations,
+#             or (activations, aux_scalar) when ``stage_aux=True`` (the MoE
+#             router-loss carry: each stage contributes its local layers' aux)
 # LossFn:     (params, activations, microbatch_dict) -> (scalar loss, scalar denom)
+# The microbatch dict passed to StageFn additionally carries ``_chunk`` (the
+# virtual-pipeline chunk index, 0 when vp == 1) so stages can derive
+# stage-unique PRNG keys for dropout.
 EmbedFn = Callable[[Any, dict], jax.Array]
 StageFn = Callable[[Any, jax.Array, dict], jax.Array]
 LossFn = Callable[[Any, jax.Array, dict], tuple]
@@ -98,6 +103,8 @@ def pipeline_loss(
     mesh=None,
     num_microbatches: Optional[int] = None,
     virtual_pipeline_size: int = 1,
+    stage_aux: bool = False,
+    aux_scale: float = 0.0,
 ) -> jax.Array:
     """Scalar pipeline-parallel loss (mean over microbatches).
 
@@ -129,18 +136,23 @@ def pipeline_loss(
 
         def body(acc, mb):
             x = embed_fn(params, mb)
-            x = stage_fn(layer_params, x, mb)
+            out = stage_fn(layer_params, x, {**mb, "_chunk": jnp.zeros((), jnp.int32)})
+            x, s_aux = out if stage_aux else (out, jnp.zeros((), jnp.float32))
             loss, denom = loss_fn(params, x, mb)
-            return (acc[0] + loss, acc[1] + denom), None
+            return (acc[0] + loss, acc[1] + denom, acc[2] + s_aux), None
 
-        (loss_sum, denom_sum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), microbatches
+        (loss_sum, denom_sum, aux_sum), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+             jnp.zeros((), jnp.float32)),
+            microbatches,
         )
-        return loss_sum / jnp.maximum(denom_sum, 1.0)
+        return loss_sum / jnp.maximum(denom_sum, 1.0) + aux_scale * aux_sum
 
     body = functools.partial(
         _pipeline_body,
         embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, pp=pp, nm=nm, vp=vp,
+        stage_aux=stage_aux, aux_scale=aux_scale,
     )
     from jax.sharding import PartitionSpec as P
 
@@ -159,7 +171,7 @@ def pipeline_loss(
 
 
 def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
-                   loss_fn, pp, nm, vp):
+                   loss_fn, pp, nm, vp, stage_aux=False, aux_scale=0.0):
     """Per-pipe-rank circular wavefront loop (inside shard_map, manual "pipe").
 
     Schedule: rank ``r`` at tick ``t`` works on work-index ``w = t - r`` —
@@ -190,7 +202,7 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
 
     def tick(carry, t):
-        recv, circ, loss_acc, denom_acc = carry
+        recv, circ, loss_acc, denom_acc, aux_acc = carry
 
         if vp > 1:
             # rank 0: recv holds last-rank output from tick t-1 (work index
@@ -223,7 +235,13 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
             lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
             local_layers,
         )
-        y = compute(lp_c, x, mb)
+        out = compute(lp_c, x, {**mb, "_chunk": c})
+        y, s_aux = out if stage_aux else (out, jnp.zeros((), jnp.float32))
+        # every rank+chunk contributes its local layers' aux once per valid
+        # work index (the MoE router-loss carry: psum over pipe at the end
+        # sums over ALL layers, exactly like the unpipelined scan carry)
+        work_valid = jnp.logical_and(w >= 0, w < nm * vp)
+        aux_acc = aux_acc + jnp.where(work_valid, s_aux, 0.0)
 
         loss, denom = loss_fn(params, y, mb)
         valid = jnp.logical_and(
@@ -233,18 +251,20 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
         denom_acc = denom_acc + jnp.where(valid, denom, 0.0)
 
         recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
-        return (recv, circ, loss_acc, denom_acc), None
+        return (recv, circ, loss_acc, denom_acc, aux_acc), None
 
     zeros = jnp.zeros_like(x0)
     circ0 = (
         jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1 else jnp.zeros((1, 1), x0.dtype)
     )
-    (_, _, loss_acc, denom_acc), _ = jax.lax.scan(
+    (_, _, loss_acc, denom_acc, aux_acc), _ = jax.lax.scan(
         tick,
-        (zeros, circ0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (zeros, circ0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+         jnp.zeros((), jnp.float32)),
         jnp.arange(nm * vp + pp - 1),
     )
     # only the last rank's accumulators are real; psum broadcasts the scalars
     loss_total = jax.lax.psum(loss_acc, PIPE_AXIS)
     denom_total = jax.lax.psum(denom_acc, PIPE_AXIS)
-    return loss_total / jnp.maximum(denom_total, 1.0)
+    aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
+    return loss_total / jnp.maximum(denom_total, 1.0) + aux_scale * aux_total

@@ -1,0 +1,248 @@
+"""NeMo-Megatron (NNM) checkpoint converter: Megatron-named state dicts <->
+native GPT pytrees.
+
+The reference ships ``nnm_model_ckpt_to_nxdt_model_ckpt_converter.py`` (205
+LoC): it walks ``tp_rank_XX_pp_rank_XXX/model_optim_rng.ckpt`` shard files,
+offsets layer indices by ``pp_rank * layers_per_stage``, and re-serializes
+per-rank xser files.  TPU-native there is no rank-sharded file layout — the
+native format is ONE logical pytree (Orbax shards storage transparently) — so
+the converter has two independent stages:
+
+1. ``merge_nnm_shards``: dict[(tp_rank, pp_rank)] of Megatron-sharded state
+   dicts -> one full Megatron-named state dict (concat TP shards on the
+   parallel dim, offset PP-local layer indices) — replacing the reference's
+   rank-file loop;
+2. ``megatron_gpt_to_native`` / ``native_to_megatron_gpt``: pure name/layout
+   mapping between Megatron naming (``language_model.encoder.layers.N...``)
+   and the native stacked-layer pytree (``models.gpt``), including the
+   QKV head-group de-interleave (Megatron stores per-group [q..q, k, v]; the
+   native fused qkv is [all Q | all K | all V]).
+
+All weights transpose from torch's [out, in] to the MXU-friendly [in, out].
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import numpy as np
+
+from neuronx_distributed_training_tpu.tools.convert import _stack, _t, _unstack
+
+_LAYER_RE = re.compile(r"(\.layers\.)(\d+)(\.)")
+
+
+def _norm_key(k: str) -> str:
+    """Normalize prefixes: ``model.language_model...`` -> ``language_model...``
+    (the reference strips the same prefix, converter ``:145``)."""
+    if k.startswith("model."):
+        k = k[len("model."):]
+    return k
+
+
+def _offset_layer(k: str, offset: int) -> str:
+    m = _LAYER_RE.search(k)
+    if not m:
+        return k
+    return k[: m.start(2)] + str(int(m.group(2)) + offset) + k[m.end(2):]
+
+
+def _deinterleave_qkv(w: np.ndarray, nh: int, nkv: int, d: int):
+    """Megatron fused qkv [(nkv*(q_per+2))*d, ...] -> (q [nh*d,...], k, v).
+
+    Megatron groups by kv head: for each of the ``nkv`` groups the rows are
+    ``q_per`` query heads then one K then one V head (reference
+    ``transformer.py:470-777`` ParallelAttention layout).
+    """
+    q_per = nh // nkv
+    g = w.reshape((nkv, q_per + 2, d) + w.shape[1:])
+    q = g[:, :q_per].reshape((nh * d,) + w.shape[1:])
+    k = g[:, q_per].reshape((nkv * d,) + w.shape[1:])
+    v = g[:, q_per + 1].reshape((nkv * d,) + w.shape[1:])
+    return q, k, v
+
+
+def _interleave_qkv(q: np.ndarray, k: np.ndarray, v: np.ndarray,
+                    nh: int, nkv: int, d: int) -> np.ndarray:
+    q_per = nh // nkv
+    tail = q.shape[1:]
+    qg = q.reshape((nkv, q_per, d) + tail)
+    kg = k.reshape((nkv, 1, d) + tail)
+    vg = v.reshape((nkv, 1, d) + tail)
+    return np.concatenate([qg, kg, vg], axis=1).reshape(
+        (nkv * (q_per + 2) * d,) + tail
+    )
+
+
+def megatron_gpt_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
+    """Full (unsharded) Megatron-named state dict -> native GPT param pytree.
+
+    ``cfg`` is a ``models.gpt.GPTConfig``.  Accepts both ``model.language_model``
+    and ``language_model`` prefixes.
+    """
+    st = {_norm_key(k): np.asarray(v) for k, v in state.items()}
+    g = lambda name: st["language_model." + name]
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+
+    def norm(prefix):
+        out = {"scale": g(prefix + ".weight")}
+        if cfg.normalization == "layernorm":
+            out["bias"] = g(prefix + ".bias")
+        return out
+
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layers.{i}."
+        qw, kw, vw = _deinterleave_qkv(
+            g(pre + "self_attention.query_key_value.weight"), nh, nkv, d
+        )
+        attn = {
+            "qkv": {"w": _t(np.concatenate([qw, kw, vw], axis=0))},
+            "o": {"w": _t(g(pre + "self_attention.dense.weight"))},
+        }
+        if cfg.bias:
+            qb, kb, vb = _deinterleave_qkv(
+                g(pre + "self_attention.query_key_value.bias"), nh, nkv, d
+            )
+            attn["qkv"]["bias"] = np.concatenate([qb, kb, vb], axis=0)
+            attn["o"]["bias"] = g(pre + "self_attention.dense.bias")
+        mlp = {
+            "up": {"w": _t(g(pre + "mlp.dense_h_to_4h.weight"))},
+            "down": {"w": _t(g(pre + "mlp.dense_4h_to_h.weight"))},
+        }
+        if cfg.bias:
+            mlp["up"]["bias"] = g(pre + "mlp.dense_h_to_4h.bias")
+            mlp["down"]["bias"] = g(pre + "mlp.dense_4h_to_h.bias")
+        layers.append({
+            "input_norm": norm(pre + "input_layernorm"),
+            "post_attn_norm": norm(pre + "post_attention_layernorm"),
+            "attn": attn,
+            "mlp": mlp,
+        })
+
+    params: dict[str, Any] = {
+        "embed": {"embedding": g("embedding.word_embeddings.weight")},
+        "layers": _stack(layers),
+        "final_norm": norm("encoder.final_layernorm"),
+    }
+    if cfg.position_embedding_type == "learned_absolute":
+        params["pos_embed"] = {
+            "embedding": g("embedding.position_embeddings.weight")
+        }
+    if not cfg.share_embeddings_and_output_weights:
+        params["lm_head"] = {"w": _t(g("output_layer.weight"))}
+    return params
+
+
+def native_to_megatron_gpt(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
+    """Inverse of ``megatron_gpt_to_native`` (export / parity testing)."""
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    out: dict[str, np.ndarray] = {}
+    p = lambda name, v: out.update({"language_model." + name: np.asarray(v)})
+
+    p("embedding.word_embeddings.weight", params["embed"]["embedding"])
+    if cfg.position_embedding_type == "learned_absolute":
+        p("embedding.position_embeddings.weight", params["pos_embed"]["embedding"])
+
+    def put_norm(prefix, tree):
+        p(prefix + ".weight", tree["scale"])
+        if cfg.normalization == "layernorm":
+            p(prefix + ".bias", tree["bias"])
+
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layers.{i}."
+        lp = _unstack(params["layers"], i)
+        put_norm(pre + "input_layernorm", lp["input_norm"])
+        put_norm(pre + "post_attention_layernorm", lp["post_attn_norm"])
+        qkv_t = _t(lp["attn"]["qkv"]["w"])  # [(nh+2kv)d, H]
+        q, k, v = np.split(qkv_t, [nh * d, (nh + nkv) * d], axis=0)
+        p(pre + "self_attention.query_key_value.weight",
+          _interleave_qkv(q, k, v, nh, nkv, d))
+        p(pre + "self_attention.dense.weight", _t(lp["attn"]["o"]["w"]))
+        p(pre + "mlp.dense_h_to_4h.weight", _t(lp["mlp"]["up"]["w"]))
+        p(pre + "mlp.dense_4h_to_h.weight", _t(lp["mlp"]["down"]["w"]))
+        if cfg.bias:
+            qb, kb, vb = np.split(
+                lp["attn"]["qkv"]["bias"], [nh * d, (nh + nkv) * d], axis=0
+            )
+            p(pre + "self_attention.query_key_value.bias",
+              _interleave_qkv(qb, kb, vb, nh, nkv, d))
+            p(pre + "self_attention.dense.bias", lp["attn"]["o"]["bias"])
+            p(pre + "mlp.dense_h_to_4h.bias", lp["mlp"]["up"]["bias"])
+            p(pre + "mlp.dense_4h_to_h.bias", lp["mlp"]["down"]["bias"])
+    put_norm("encoder.final_layernorm", params["final_norm"])
+    if not cfg.share_embeddings_and_output_weights:
+        p("output_layer.weight", _t(params["lm_head"]["w"]))
+    return out
+
+
+# TP-merge rules by key suffix: (concat_axis | None = replicated-take-rank0),
+# matching Megatron's Column/RowParallelLinear shard dims in torch [out, in]
+# layout (reference layers: qkv/h_to_4h column -> dim 0; dense/4h_to_h row ->
+# dim 1; embeddings vocab -> dim 0; norms/biases-of-row replicated).
+_TP_AXIS: list[tuple[str, int | None]] = [
+    ("embedding.word_embeddings.weight", 0),
+    ("embedding.position_embeddings.weight", None),
+    ("self_attention.query_key_value.weight", 0),
+    ("self_attention.query_key_value.bias", 0),
+    ("self_attention.dense.weight", 1),
+    ("self_attention.dense.bias", None),
+    ("mlp.dense_h_to_4h.weight", 0),
+    ("mlp.dense_h_to_4h.bias", 0),
+    ("mlp.dense_4h_to_h.weight", 1),
+    ("mlp.dense_4h_to_h.bias", None),
+    ("output_layer.weight", 0),
+    ("layernorm.weight", None),
+    ("layernorm.bias", None),
+]
+
+
+def _tp_axis_for(key: str) -> int | None:
+    for suffix, ax in _TP_AXIS:
+        if key.endswith(suffix) or suffix in key:
+            return ax
+    return None  # unknown keys treated as replicated
+
+
+def merge_nnm_shards(
+    shards: Mapping[tuple[int, int], Mapping[str, Any]],
+    *,
+    tp: int,
+    pp: int,
+    num_layers: int,
+    glu: bool = False,
+) -> dict[str, np.ndarray]:
+    """dict[(tp_rank, pp_rank)] of Megatron shard state dicts -> full dict.
+
+    Layer indices in each pp shard are local; they are offset by
+    ``pp_rank * num_layers // pp`` (the reference's ``modify_layer_string``).
+    ``glu``: ``dense_h_to_4h`` holds [gate; up] per rank — merged per-half so
+    the full tensor stays [gate_full; up_full].
+    """
+    per_stage = num_layers // pp
+    full: dict[str, np.ndarray] = {}
+    for pp_rank in range(pp):
+        # gather each key's tp shards in rank order
+        keys = [_norm_key(k) for k in shards[(0, pp_rank)].keys()]
+        for key in keys:
+            parts = [
+                np.asarray(_lookup(shards[(r, pp_rank)], key)) for r in range(tp)
+            ]
+            ax = _tp_axis_for(key)
+            if ax is None or tp == 1:
+                merged = parts[0]
+            elif glu and "dense_h_to_4h" in key:
+                halves = [p.reshape((2, p.shape[0] // 2) + p.shape[1:]) for p in parts]
+                merged = np.concatenate(halves, axis=1)
+                merged = merged.reshape((-1,) + merged.shape[2:])
+            else:
+                merged = np.concatenate(parts, axis=ax)
+            full[_offset_layer(key, pp_rank * per_stage)] = merged
+    return full
+
+
+def _lookup(shard: Mapping[str, Any], norm_key: str):
+    if norm_key in shard:
+        return shard[norm_key]
+    return shard["model." + norm_key]

@@ -123,13 +123,17 @@ class ExpManager:
 
     # -- per-step hooks -----------------------------------------------------
 
-    def step_timed(self) -> float:
-        """Record a step boundary; returns step wall seconds (0.0 on first)."""
+    def step_timed(self, num_steps: int = 1) -> float:
+        """Record a step boundary covering ``num_steps`` steps since the last
+        call; returns per-step wall seconds (0.0 on first)."""
         now = time.perf_counter()
-        dt = 0.0 if self._last_step_time is None else now - self._last_step_time
+        dt = (
+            0.0 if self._last_step_time is None
+            else (now - self._last_step_time) / max(num_steps, 1)
+        )
         self._last_step_time = now
         if dt > 0:
-            self._last_tput = self.throughput.update(dt)
+            self._last_tput = self.throughput.update(dt, num_steps=num_steps)
         return dt
 
     def log_metrics(self, step: int, metrics: dict[str, Any], *, force: bool = False) -> None:

@@ -112,14 +112,15 @@ class Trainer:
         )
         params = init_fn(jax.random.PRNGKey(seed))
 
-        # DPO swaps the loss for the preference objective; the pre-fit
-        # reference-logprob pass runs in fit() (reference base_dpo.py:23-66)
-        if alignment == "dpo":
-            from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
-
+        # DPO/ORPO swap the loss for the preference objective; DPO's pre-fit
+        # reference-logprob pass runs in fit() (reference base_dpo.py:23-66),
+        # ORPO needs no reference model (reference base_orpo.py:26-46)
+        if alignment in ("dpo", "orpo"):
             if not isinstance(model_cfg, llama.LlamaConfig):
-                raise NotImplementedError("DPO is wired for the llama family only")
-            dpo_cfg = dict((cfg.get("model", {}) or {}).get("dpo", {}) or {})
+                raise NotImplementedError(
+                    f"{alignment.upper()} is wired for the llama family only"
+                )
+            dpo_cfg = dict((cfg.get("model", {}) or {}).get(alignment, {}) or {})
             mc_ref = model_cfg
 
             def forward_logits(p, batch):
@@ -128,7 +129,14 @@ class Trainer:
 
             # reference spells it kl_beta in the strategy block
             beta = float(align_params.get("kl_beta", dpo_cfg.get("beta", 0.1)))
-            loss_fn = make_dpo_loss_fn(forward_logits, beta=beta)
+            if alignment == "dpo":
+                from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
+
+                loss_fn = make_dpo_loss_fn(forward_logits, beta=beta)
+            else:
+                from neuronx_distributed_training_tpu.alignment.orpo import make_orpo_loss_fn
+
+                loss_fn = make_orpo_loss_fn(forward_logits, beta=beta)
 
         # LoRA: inject adapters + freeze base weights (reference
         # llama_model.py:51-65 -> nxd lora_config)
@@ -151,8 +159,6 @@ class Trainer:
         pp = int(mesh.shape.get("pipe", 1))
         num_micro_in_step = sched["num_microbatches"]
         eval_loss_fn = loss_fn
-        if pp > 1 and alignment == "dpo":
-            raise NotImplementedError("DPO + pipeline parallelism not supported yet")
         if pp > 1:
             # pipeline path: microbatching moves inside the pipelined loss
             # (reference base.py:374-383 run_train); layer stack sharded over
@@ -171,16 +177,51 @@ class Trainer:
             vp = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
             # fail early with a clear message instead of an opaque GSPMD error
             stage_layer_slice(int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
-            hooks = pipeline_hooks_for(cfg, model_cfg, policy, shift_labels=shift_labels)
             nm = sched["num_microbatches"]
-            embed_fn, stage_fn, stage_loss_fn = hooks
+            if alignment in ("dpo", "orpo"):
+                # preference losses pipeline via the concatenated forward
+                # (reference base_dpo.py:68-88 runs chosen+rejected through
+                # NxDPPModel as one doubled batch)
+                if vp > 1 and alignment == "dpo":
+                    raise NotImplementedError(
+                        "DPO + interleaved pipeline (vp > 1): the pre-fit "
+                        "reference pass needs the flat layer layout"
+                    )
+                from neuronx_distributed_training_tpu.alignment.dpo import (
+                    preference_pipeline_hooks,
+                )
+                from neuronx_distributed_training_tpu.ops import norm as norm_ops
+
+                base_embed, base_stage, _ = llama.pipeline_hooks(model_cfg, policy)
+
+                def head_fn(p, y):
+                    h = norm_ops.apply_rms_norm(
+                        p["final_norm"], y, eps=model_cfg.rms_norm_eps
+                    )
+                    return llama.logits_fn(p, h, model_cfg, policy)
+
+                embed_fn, stage_fn, stage_loss_fn = preference_pipeline_hooks(
+                    base_embed, base_stage, head_fn, mode=alignment, beta=beta
+                )
+                hook_opts: dict = {}
+            else:
+                (embed_fn, stage_fn, stage_loss_fn), hook_opts = pipeline_hooks_for(
+                    cfg, model_cfg, policy, shift_labels=shift_labels
+                )
+            stage_aux = bool(hook_opts.get("stage_aux"))
+            aux_scale = float(hook_opts.get("aux_inv_layers", 0.0)) / nm
+            needs_rng = bool(hook_opts.get("needs_rng"))
 
             def loss_fn(p, batch, key):  # noqa: F811 — pipelined replacement
                 mbs = microbatch_split(batch, nm)
+                if needs_rng and key is not None:
+                    mbs = dict(mbs)
+                    mbs["_rng"] = jax.random.split(key, nm)
                 loss = pipeline_loss(
                     p, p["layers"], mbs,
                     embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=stage_loss_fn,
                     mesh=mesh, num_microbatches=nm, virtual_pipeline_size=vp,
+                    stage_aux=stage_aux, aux_scale=aux_scale,
                 )
                 return loss, {}
 
@@ -204,11 +245,10 @@ class Trainer:
         opt_cfg = AdamWConfig.from_config(opt_block, cfg.get("trainer", {}))
         zero1 = bool(cfg.get("distributed_strategy", {}).get("zero1", True))
         opt_state = init_opt_state(params, policy)
-        ospecs = opt_state_specs(
-            params, pspecs, mesh, zero1=zero1, policy=policy,
-            # see opt_state_specs: XLA scatter-partitioner crash under pp
-            zero1_exclude=("embed",) if pp > 1 else (),
-        )
+        # full ZeRO-1 including the embedding: the pipeline embed hooks use the
+        # one-hot matmul form (ops.linear.apply_embedding via_matmul) so no
+        # gather-transpose scatter reaches the partitioner under manual pipe
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=zero1, policy=policy)
 
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
         lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
@@ -357,9 +397,11 @@ class Trainer:
         self.maybe_resume()
         last_metrics: dict[str, float] = {}
         batches = self.data_module.sharded_batches(self.mesh)
+        log_every = max(1, int(self.exp.log_every_n_steps))
         try:
             with self.mesh, shd.use_mesh(self.mesh):
                 self.exp.step_timed()  # arm the step timer
+                last_fetch = self.step
                 while self.step < self.max_steps:
                     self.exp.maybe_profile(self.step)
                     batch = next(batches)
@@ -368,9 +410,23 @@ class Trainer:
                         self.params, self.opt_state, batch, key
                     )
                     self.step += 1
-                    # host sync happens here (metric fetch), once per step
+                    # host sync ONLY at logging/validation/checkpoint
+                    # boundaries: between them the loop keeps dispatching
+                    # ahead of the device (the reference batches metric
+                    # fetches the same way via xm.add_step_closure,
+                    # base.py:235-250)
+                    boundary = (
+                        self.step % log_every == 0
+                        or self.step == self.max_steps
+                        or (val_interval and self.step % val_interval == 0)
+                        or (ck_every and self.step % ck_every == 0)
+                    )
+                    if not boundary:
+                        continue
+                    n_since = self.step - last_fetch
+                    last_fetch = self.step
                     last_metrics = {k: float(v) for k, v in metrics.items()}
-                    dt = self.exp.step_timed()
+                    dt = self.exp.step_timed(n_since)
                     last_metrics["step_time"] = dt
                     last_metrics["consumed_samples"] = self.data_module.consumed_samples
                     self.exp.log_metrics(self.step, last_metrics)
@@ -474,9 +530,34 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = Tr
 
 def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
                        *, shift_labels: bool = True):
-    """Pipeline hooks dispatch (llama-family only so far)."""
+    """Pipeline hooks dispatch -> ``((embed, stage, loss), opts)``.
+
+    ``opts``: ``stage_aux`` (stage returns ``(x, aux)``), ``aux_inv_layers``
+    (1/num_layers scale for the psum'd MoE router loss; the caller divides by
+    num_microbatches), ``needs_rng`` (thread per-microbatch dropout keys).
+    The reference pipelines every model source the same way
+    (``megatron_gpt_model.py:67-77`` sets ``transformer_layer_cls``).
+    """
     if isinstance(model_cfg, llama.LlamaConfig):
-        return llama.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels)
+        return llama.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels), {}
+    from neuronx_distributed_training_tpu.models import gpt, mixtral
+
+    if isinstance(model_cfg, mixtral.MixtralConfig):
+        return (
+            mixtral.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels),
+            {"stage_aux": True, "aux_inv_layers": 1.0 / model_cfg.num_layers},
+        )
+    if isinstance(model_cfg, gpt.GPTConfig):
+        opts = {
+            "stage_aux": True,
+            "aux_inv_layers": (
+                1.0 / model_cfg.num_layers if model_cfg.moe is not None else 0.0
+            ),
+            "needs_rng": (
+                model_cfg.hidden_dropout > 0.0 or model_cfg.embedding_dropout > 0.0
+            ),
+        }
+        return gpt.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels), opts
     raise NotImplementedError(
         f"pipeline parallelism not wired for {type(model_cfg).__name__} yet"
     )

@@ -60,14 +60,22 @@ def make_train_step(
 
     def grad_one_microbatch(params, mb, step_key):
         def scalar_loss(p):
-            loss, _aux = loss_fn(p, mb, step_key)
-            return loss.astype(jnp.float32)
+            loss, aux = loss_fn(p, mb, step_key)
+            # scalar aux entries (DPO rewards, ORPO odds, MoE router loss)
+            # surface as logged metrics — the reference's misc_metrics flow
+            # (base_dpo.py:104-109); non-scalars (logits) stay internal
+            scalars = {
+                k: jnp.asarray(v, jnp.float32)
+                for k, v in aux.items()
+                if jnp.ndim(v) == 0
+            }
+            return loss.astype(jnp.float32), scalars
 
-        return jax.value_and_grad(scalar_loss)(params)
+        return jax.value_and_grad(scalar_loss, has_aux=True)(params)
 
     def train_step(params, opt_state, batch, step_key):
         if num_microbatches == 1:
-            loss, grads = grad_one_microbatch(params, batch, step_key)
+            (loss, aux), grads = grad_one_microbatch(params, batch, step_key)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(policy.grad_accum_dtype), grads
             )
@@ -76,19 +84,22 @@ def make_train_step(
 
             def body(carry, mb):
                 loss_sum, grad_sum = carry
-                loss, grads = grad_one_microbatch(params, mb, step_key)
+                (loss, aux), grads = grad_one_microbatch(params, mb, step_key)
                 grad_sum = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(policy.grad_accum_dtype), grad_sum, grads
                 )
-                return (loss_sum + loss, grad_sum), None
+                return (loss_sum + loss, grad_sum), aux
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, policy.grad_accum_dtype), params
             )
-            (loss_sum, grad_sum), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32), zeros), mbs)
+            (loss_sum, grad_sum), aux_stack = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros), mbs
+            )
             inv = 1.0 / num_microbatches
             loss = loss_sum * inv
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+            aux = {k: jnp.mean(v) for k, v in aux_stack.items()}
 
         lr = lr_schedule(opt_state["step"])
         new_params, new_opt_state, opt_metrics = adamw_update(
@@ -100,6 +111,7 @@ def make_train_step(
             "lr": jnp.asarray(lr, jnp.float32),
             "grad_norm": opt_metrics["grad_norm"],
         }
+        metrics.update({k: v for k, v in aux.items() if k not in metrics})
         if log_param_norm:
             # reference log_parameter_norm (base.py:397-452): TP/CP/PP-group
             # all-reduced norm — here a plain global norm (params are one

@@ -105,11 +105,11 @@ class Throughput:
         self.peak = 0.0
         self.total_seqs = 0
 
-    def update(self, step_seconds: float) -> float:
+    def update(self, step_seconds: float, num_steps: int = 1) -> float:
         self._times.append(step_seconds)
         if len(self._times) > self.window:
             self._times.pop(0)
-        self.total_seqs += self.batch_size
+        self.total_seqs += self.batch_size * num_steps
         tput = self.batch_size * len(self._times) / sum(self._times)
         self.peak = max(self.peak, tput)
         return tput

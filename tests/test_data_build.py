@@ -247,3 +247,57 @@ def test_prepare_dataset_tool(tmp_path):
 
     idx = IndexedDataset(out_meg)
     assert len(idx) == 40
+
+
+class TestPromptTemplates:
+    """Reference model_alignment_data_module.py:94-121 prompt_datasets."""
+
+    def test_format_template(self):
+        from neuronx_distributed_training_tpu.data.templates import build_template
+
+        t = build_template({"prompt_template": {
+            "input": "Question: {question}\nAnswer:", "output": " {answer}"}})
+        rec = t({"question": "why", "answer": "because"})
+        assert rec["input"] == "Question: why\nAnswer:"
+        assert rec["output"] == " because"
+
+    def test_no_template_is_none(self):
+        from neuronx_distributed_training_tpu.data.templates import build_template
+
+        assert build_template({}) is None
+
+    def test_sft_module_applies_template(self):
+        from neuronx_distributed_training_tpu.data.modules import SFTDataModule
+        from neuronx_distributed_training_tpu.data.templates import FormatTemplate
+
+        class CharTok:
+            eos_token_id = 1
+
+            def encode(self, s):
+                return [3 + (ord(c) % 60) for c in s]
+
+        tok = CharTok()
+        records = [{"question": f"q{i}", "answer": "a" * 8} for i in range(8)]
+        tmpl = FormatTemplate("Q: {question}", "{answer}")
+        dm = SFTDataModule(records, tok, seq_length=32, global_batch_size=4,
+                           packing=False, template=tmpl)
+        # prompt tokens are label-masked; the 8-char answer is not
+        assert dm.arrays["loss_mask"].sum() > 0
+        templated = tmpl(records[0])
+        n_resp = len(tok.encode(templated["output"]))
+        assert dm.arrays["loss_mask"][0].sum() == n_resp
+
+    def test_chat_template_extracts_last_assistant_turn(self):
+        from neuronx_distributed_training_tpu.data.templates import ChatTemplate
+
+        class FakeTok:
+            def apply_chat_template(self, msgs, tokenize=False,
+                                    add_generation_prompt=True):
+                return "".join(f"<{m['role']}>{m['content']}" for m in msgs) + "<assistant>"
+
+        t = ChatTemplate(FakeTok())
+        rec = t({"messages": [
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"}]})
+        assert rec["input"] == "<user>hi<assistant>"
+        assert rec["output"] == "hello"

@@ -162,6 +162,222 @@ class TestVirtualPipeline:
                 err_msg=f"grad mismatch at {path}",
             )
 
+    def test_mixtral_pp2_matches_per_microbatch_reference(self, devices8):
+        """Mixtral under pp=2: lm loss + psum'd router aux must equal the mean
+        of per-microbatch unpipelined forwards (routing is per-microbatch, so
+        that — not the flat-batch forward — is the exact reference)."""
+        import dataclasses
+
+        from neuronx_distributed_training_tpu.models import mixtral
+        from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+        cfg = mixtral.MixtralConfig(
+            llama=dataclasses.replace(CFG, num_layers=4),
+            moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True,
+                                  router_aux_loss_coef=0.02),
+        )
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1))
+        nm = mbs["input_ids"].shape[0]
+
+        def ref(p, m):
+            def body(acc, mb):
+                loss, _ = mixtral.forward(p, mb, cfg, FP32)
+                return acc + loss, None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+            return total / nm
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+        embed_fn, stage_fn, loss_fn = mixtral.pipeline_hooks(cfg, FP32)
+
+        def pl(p, m):
+            return pipeline_loss(
+                p, p["layers"], m,
+                embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                mesh=mesh, stage_aux=True,
+                aux_scale=1.0 / (nm * cfg.num_layers),
+            )
+
+        specs = mixtral.param_specs(cfg, pipeline=True)
+        ns = functools.partial(NamedSharding, mesh)
+        sh_params = jax.device_put(
+            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+        for path in (
+            ("layers", "mlp", "router", "w"),
+            ("layers", "mlp", "experts", "gate_up"),
+            ("embed", "embedding"),
+        ):
+            g, rg = grads, ref_g
+            for k in path:
+                g, rg = g[k], rg[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+                err_msg=f"grad mismatch at {path}",
+            )
+
+    def test_gpt_pp2_matches_unpipelined(self, devices8):
+        """Megatron GPT (learned-abs pos, layernorm+bias, gelu, tied head)
+        under pp=2 matches the flat-batch forward."""
+        from neuronx_distributed_training_tpu.models import gpt
+
+        cfg = gpt.GPTConfig(
+            vocab_size=128, hidden_size=32, num_layers=4, num_attention_heads=4,
+            max_position_embeddings=32, position_embedding_type="learned_absolute",
+            activations_checkpoint_granularity=None,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1))
+
+        def ref(p, m):
+            return gpt.forward(p, flat_batch(m), cfg, FP32)[0]
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+        embed_fn, stage_fn, loss_fn = gpt.pipeline_hooks(cfg, FP32)
+
+        def pl(p, m):
+            return pipeline_loss(
+                p, p["layers"], m,
+                embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                mesh=mesh, stage_aux=True, aux_scale=0.0,
+            )
+
+        specs = gpt.param_specs(cfg, pipeline=True)
+        ns = functools.partial(NamedSharding, mesh)
+        sh_params = jax.device_put(
+            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+        for path in (("embed", "embedding"), ("layers", "attn", "qkv", "w"),
+                     ("pos_embed", "embedding")):
+            g, rg = grads, ref_g
+            for k in path:
+                g, rg = g[k], rg[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+                err_msg=f"grad mismatch at {path}",
+            )
+
+    def test_gpt_pp2_dropout_runs(self, devices8):
+        """Dropout under pp: per-microbatch _rng keys thread through stages."""
+        from neuronx_distributed_training_tpu.models import gpt
+
+        cfg = gpt.GPTConfig(
+            vocab_size=128, hidden_size=32, num_layers=4, num_attention_heads=4,
+            max_position_embeddings=32, hidden_dropout=0.1, embedding_dropout=0.1,
+            activations_checkpoint_granularity=None,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = dict(microbatches(jax.random.PRNGKey(1)))
+        mbs["_rng"] = jax.random.split(jax.random.PRNGKey(7), 4)
+
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+        embed_fn, stage_fn, loss_fn = gpt.pipeline_hooks(cfg, FP32)
+
+        def pl(p, m):
+            return pipeline_loss(
+                p, p["layers"], m,
+                embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                mesh=mesh, stage_aux=True,
+            )
+
+        specs = gpt.param_specs(cfg, pipeline=True)
+        ns = functools.partial(NamedSharding, mesh)
+        sh_params = jax.device_put(
+            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+        assert np.isfinite(float(loss))
+        assert np.all(np.isfinite(np.asarray(grads["layers"]["attn"]["qkv"]["w"])))
+
+
+class TestPreferencePipeline:
+    """DPO/ORPO under pp via the concatenated forward (reference base_dpo.py:68-88)."""
+
+    def _pref_mbs(self, key, nm=2, mb=4, s=16):
+        kc, kr = jax.random.split(key)
+        return {
+            "chosen_input_ids": jax.random.randint(kc, (nm, mb, s), 0, CFG.vocab_size),
+            "rejected_input_ids": jax.random.randint(kr, (nm, mb, s), 0, CFG.vocab_size),
+        }
+
+    @pytest.mark.parametrize("mode", ["dpo", "orpo"])
+    def test_pp2_matches_direct_loss(self, devices8, mode):
+        from neuronx_distributed_training_tpu.alignment.dpo import (
+            make_dpo_loss_fn,
+            preference_pipeline_hooks,
+        )
+        from neuronx_distributed_training_tpu.alignment.orpo import make_orpo_loss_fn
+        from neuronx_distributed_training_tpu.ops import norm as norm_ops
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+        mbs = self._pref_mbs(jax.random.PRNGKey(1))
+        nm = mbs["chosen_input_ids"].shape[0]
+        if mode == "dpo":
+            mbs["reference_chosen_logps"] = -5.0 * jnp.ones((nm, 4))
+            mbs["reference_rejected_logps"] = -6.0 * jnp.ones((nm, 4))
+
+        def fwd(p, batch):
+            return llama.forward(p, batch, CFG, FP32)[0]  # no labels -> logits
+
+        direct = (make_dpo_loss_fn(fwd, beta=0.1) if mode == "dpo"
+                  else make_orpo_loss_fn(fwd, beta=0.1))
+
+        def ref(p, m):
+            def body(acc, mb):
+                loss, _ = direct(p, mb, None)
+                return acc + loss, None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+            return total / nm
+
+        ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+        base_embed, base_stage, _ = llama.pipeline_hooks(CFG, FP32)
+
+        def head_fn(p, y):
+            h = norm_ops.apply_rms_norm(p["final_norm"], y, eps=CFG.rms_norm_eps)
+            return llama.logits_fn(p, h, CFG, FP32)
+
+        embed_fn, stage_fn, loss_fn = preference_pipeline_hooks(
+            base_embed, base_stage, head_fn, mode=mode, beta=0.1
+        )
+
+        def pl(p, m):
+            return pipeline_loss(
+                p, p["layers"], m,
+                embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, mesh=mesh,
+            )
+
+        specs = llama.param_specs(CFG, pipeline=True)
+        ns = functools.partial(NamedSharding, mesh)
+        sh_params = jax.device_put(
+            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+        for path in (("embed", "embedding"), ("layers", "attn", "qkv", "w")):
+            g, rg = grads, ref_g
+            for k in path:
+                g, rg = g[k], rg[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+                err_msg=f"grad mismatch at {path}",
+            )
+
     def test_interleave_round_trip(self):
         from neuronx_distributed_training_tpu.parallel.pipeline import (
             from_interleaved,

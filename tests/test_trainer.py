@@ -187,3 +187,25 @@ def test_dpo_trainer_end_to_end(tmp_path, devices8):
     # reference columns were attached by the pre-fit pass
     assert "reference_chosen_logps" in dm.arrays
     assert "reward_accuracy" in m or m["loss"] > 0
+
+
+def test_orpo_trainer_end_to_end(tmp_path, devices8):
+    """model_alignment_strategy: orpo — no reference pass, odds-ratio loss."""
+    from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    cfg = tiny_cfg(tmp_path, max_steps=2)
+    cfg["model_alignment_strategy"] = {"orpo": {"kl_beta": 0.2}}
+    records = [{"prompt": f"q{i}", "chosen": "yes good", "rejected": "no"}
+               for i in range(16)]
+    dm = DPODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
+    t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+    assert t.pre_fit is None  # ORPO has no frozen-reference pass
+    m = t.fit()
+    assert np.isfinite(m["loss"])
+    assert "orpo_log_odds" in m
+    assert "reference_chosen_logps" not in dm.arrays
