@@ -110,10 +110,12 @@ class GPTConfig:
             normalization=str(m.get("normalization", "layernorm")),
             layernorm_epsilon=float(m.get("layernorm_epsilon", 1e-5)),
             activation=str(m.get("activation", "gelu")),
-            bias=bool(m.get("bias", True)),
+            bias=bool(m.get("has_bias", m.get("bias", True))),
             hidden_dropout=float(m.get("hidden_dropout", 0.0)),
             embedding_dropout=float(m.get("embedding_dropout", m.get("hidden_dropout", 0.0))),
-            sliding_window=m.get("window_size", m.get("sliding_window")),
+            sliding_window=m.get(
+                "sliding_window_size", m.get("window_size", m.get("sliding_window"))
+            ),
             share_embeddings_and_output_weights=bool(
                 m.get("share_embeddings_and_output_weights", True)
             ),
@@ -269,7 +271,7 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0)
 
 
-def _attention_block(cfg, lp, x, cos, sin, policy):
+def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     qkv = linear_ops.apply_linear(lp["qkv"], x)
@@ -293,6 +295,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy):
     out = attn_ops.attention(
         q, k, v, impl=cfg.attention_impl, causal=True,
         sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
+        attention_mask=attention_mask,
     )
     return linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
 
@@ -309,14 +312,16 @@ def _mlp_block(cfg, lp, x, policy):
     return linear_ops.apply_linear(lp["down"], y), jnp.zeros((), jnp.float32)
 
 
-def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key):
+def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
+                   attention_mask=None):
     aspec = shd.act_spec(cfg.sequence_parallel, False)
     k1 = k2 = None
     if dropout_key is not None:
         k1, k2 = jax.random.split(dropout_key)
     residual = x
     hidden = _apply_norm(cfg, lp["input_norm"], x)
-    hidden = _attention_block(cfg, lp["attn"], hidden, cos, sin, policy)
+    hidden = _attention_block(cfg, lp["attn"], hidden, cos, sin, policy,
+                              attention_mask=attention_mask)
     x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k1), aspec)
     residual = x
     hidden = _apply_norm(cfg, lp["post_attn_norm"], x)
@@ -325,11 +330,13 @@ def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key):
     return x, aux_loss
 
 
-def _rope_for(cfg: GPTConfig, input_ids: jax.Array):
+def _rope_for(cfg: GPTConfig, input_ids: jax.Array, positions=None):
     if cfg.position_embedding_type == "learned_absolute":
         return None, None
-    b, s = input_ids.shape
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    if positions is None:
+        from neuronx_distributed_training_tpu.models.llama import positions_for
+
+        positions = positions_for(input_ids)
     rot_dim = int(cfg.head_size * cfg.rotary_percentage) // 2 * 2
     inv_freq = rope_ops.rope_frequencies(rot_dim, theta=cfg.rope_theta)
     return rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
@@ -438,17 +445,21 @@ def forward(
     return_logits: bool = False,
 ):
     """Causal-LM forward -> (loss, aux) (or (logits, aux) without labels)."""
+    from neuronx_distributed_training_tpu.models.llama import positions_for
+
     input_ids = batch["input_ids"]
+    attention_mask = batch.get("attention_mask")
     b, s = input_ids.shape
     aspec = shd.act_spec(cfg.sequence_parallel, False)
+    positions = positions_for(input_ids, attention_mask)
     x = linear_ops.apply_embedding(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
     if cfg.position_embedding_type == "learned_absolute":
         x = x + jnp.take(
-            params["pos_embed"]["embedding"], jnp.arange(s), axis=0
-        ).astype(x.dtype)[None]
-    cos, sin = _rope_for(cfg, input_ids)
+            params["pos_embed"]["embedding"], positions, axis=0
+        ).astype(x.dtype)
+    cos, sin = _rope_for(cfg, input_ids, positions=positions)
     if rng is not None:
         rng, kemb = jax.random.split(rng)
         x = _dropout(x, cfg.embedding_dropout, kemb)
@@ -465,7 +476,8 @@ def forward(
             lp, lkey = inp
         else:
             lp, lkey = inp, None
-        x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey)
+        x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey,
+                                attention_mask=attention_mask)
         return (x, aux_acc + aux), None
 
     from neuronx_distributed_training_tpu.models.llama import _remat_policy
@@ -488,6 +500,10 @@ def forward(
     if labels is None:
         return logits, aux
     loss_mask = batch.get("loss_mask")
+    if attention_mask is not None:
+        # padded positions never contribute to the loss
+        am = attention_mask.astype(jnp.float32)
+        loss_mask = am if loss_mask is None else loss_mask * am
     if shift_labels:
         logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
     loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)

@@ -233,7 +233,8 @@ def param_specs(cfg: LlamaConfig, *, pipeline: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy):
+def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
+                     attention_mask=None):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     if cfg.fuse_qkv:
@@ -255,6 +256,7 @@ def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy):
         causal=True,
         sliding_window=cfg.sliding_window,
         softmax_dtype=policy.softmax_dtype,
+        attention_mask=attention_mask,
     )
     out = out.reshape(b, s, nh * d)
     # RowParallel o_proj; reduce(-scatter under SP) inserted by GSPMD
@@ -268,11 +270,13 @@ def _mlp_block(lp, x):
     return linear_ops.apply_linear(lp["down"], jax.nn.silu(gate) * up)
 
 
-def _decoder_layer(layer_params, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy):
+def _decoder_layer(layer_params, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
+                   attention_mask=None):
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
     residual = x
     hidden = norm_ops.apply_rms_norm(layer_params["input_norm"], x, eps=cfg.rms_norm_eps)
-    hidden = _attention_block(layer_params["attn"], hidden, cos, sin, cfg, policy)
+    hidden = _attention_block(layer_params["attn"], hidden, cos, sin, cfg, policy,
+                              attention_mask=attention_mask)
     x = shd.constrain(residual + hidden, aspec)
     residual = x
     hidden = norm_ops.apply_rms_norm(layer_params["post_attn_norm"], x, eps=cfg.rms_norm_eps)
@@ -300,6 +304,7 @@ def hidden_states(
     *,
     positions: Optional[jax.Array] = None,
     layers: Optional[Any] = None,  # override stacked layer params (pipeline stages)
+    attention_mask: Optional[jax.Array] = None,  # [b, s] 1 = real token
 ) -> jax.Array:
     """Embedding + scanned decoder stack + final norm -> [batch, seq, hidden]."""
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
@@ -307,8 +312,8 @@ def hidden_states(
     x = shd.constrain(x, aspec)
 
     if positions is None:
-        positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
-        positions = jnp.broadcast_to(positions, input_ids.shape)
+        # HF position_ids convention for padded batches (see positions_for)
+        positions = positions_for(input_ids, attention_mask)
     inv_freq = rope_ops.rope_frequencies(
         cfg.head_size,
         theta=cfg.rope_theta,
@@ -320,7 +325,8 @@ def hidden_states(
     layer_stack = policy.cast_to_compute(layer_stack)
 
     def body(carry, lp):
-        return _decoder_layer(lp, carry, cos, sin, cfg, policy), None
+        return _decoder_layer(lp, carry, cos, sin, cfg, policy,
+                              attention_mask=attention_mask), None
 
     remat = _remat_policy(cfg.activations_checkpoint_granularity)
     if remat is not None:
@@ -345,9 +351,20 @@ def logits_fn(params, hidden: jax.Array, cfg: LlamaConfig, policy: DtypePolicy) 
 # ---------------------------------------------------------------------------
 
 
-def _rope_for(input_ids: jax.Array, cfg: LlamaConfig):
+def positions_for(input_ids: jax.Array, attention_mask=None) -> jax.Array:
+    """RoPE/absolute position ids [b, s]: plain arange, or — for padded
+    batches — the HF convention of counting real tokens only
+    (``cumsum(attention_mask) - 1``), keeping left-padded rows phase-aligned."""
+    if attention_mask is not None:
+        m = attention_mask.astype(jnp.int32)
+        return jnp.clip(jnp.cumsum(m, axis=1) - 1, 0, None)
     positions = jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, input_ids.shape)
+    return jnp.broadcast_to(positions, input_ids.shape)
+
+
+def _rope_for(input_ids: jax.Array, cfg: LlamaConfig, positions=None):
+    if positions is None:
+        positions = positions_for(input_ids)
     inv_freq = rope_ops.rope_frequencies(
         cfg.head_size,
         theta=cfg.rope_theta,
@@ -423,7 +440,9 @@ def forward(
     pass ``shift_labels=False`` (reference ``modeling_llama.py:815-823``).
     """
     input_ids = batch["input_ids"]
-    hidden = hidden_states(params, input_ids, cfg, policy, positions=positions)
+    attention_mask = batch.get("attention_mask")
+    hidden = hidden_states(params, input_ids, cfg, policy, positions=positions,
+                           attention_mask=attention_mask)
     logits = logits_fn(params, hidden, cfg, policy)
     aux: dict[str, Any] = {}
     if return_logits:
@@ -432,6 +451,10 @@ def forward(
     if labels is None:
         return logits, aux
     loss_mask = batch.get("loss_mask")
+    if attention_mask is not None:
+        # padded positions never contribute to the loss
+        am = attention_mask.astype(jnp.float32)
+        loss_mask = am if loss_mask is None else loss_mask * am
     if shift_labels:
         logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
     loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)

@@ -112,13 +112,15 @@ def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
     return specs
 
 
-def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy):
+def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
+                   attention_mask=None):
     """Pre-LN attention + MoE block; returns (x, aux_loss)."""
     lc = cfg.llama
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
     residual = x
     hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
-    hidden = llama._attention_block(lp["attn"], hidden, cos, sin, lc, policy)
+    hidden = llama._attention_block(lp["attn"], hidden, cos, sin, lc, policy,
+                                    attention_mask=attention_mask)
     x = shd.constrain(residual + hidden, aspec)
     residual = x
     hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
@@ -196,17 +198,21 @@ def forward(
     per-layer load-balancing loss (reference ``modeling_mixtral.py:872-878``)."""
     lc = cfg.llama
     input_ids = batch["input_ids"]
+    attention_mask = batch.get("attention_mask")
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
     x = linear_ops.apply_embedding(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
     x = shd.constrain(x, aspec)
-    cos, sin = llama._rope_for(input_ids, lc)
+    cos, sin = llama._rope_for(
+        input_ids, lc, positions=llama.positions_for(input_ids, attention_mask)
+    )
     layer_stack = policy.cast_to_compute(params["layers"])
 
     def body(carry, lp):
         x, aux_acc = carry
-        x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
+        x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy,
+                                attention_mask=attention_mask)
         return (x, aux_acc + aux), None
 
     remat = llama._remat_policy(lc.activations_checkpoint_granularity)
@@ -224,6 +230,9 @@ def forward(
     if labels is None:
         return logits, aux
     loss_mask = batch.get("loss_mask")
+    if attention_mask is not None:
+        am = attention_mask.astype(jnp.float32)
+        loss_mask = am if loss_mask is None else loss_mask * am
     if shift_labels:
         logits, labels, loss_mask = ce_ops.shift_for_next_token(logits, labels, loss_mask)
     lm_loss = ce_ops.cross_entropy_loss(logits, labels, loss_mask=loss_mask)

@@ -110,6 +110,15 @@ def core_attention(
     return out.astype(q.dtype)
 
 
+def padding_mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """``attention_mask`` [b, skv] (1 = real token) -> additive bias
+    [b, 1, 1, skv] masking padded KEYS (the HF contract; reference
+    ``llama_model.py:94-101`` includes ``attention_mask`` in input_names)."""
+    neg = jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+    bias = jnp.where(attention_mask.astype(bool), jnp.asarray(0, dtype), neg)
+    return bias[:, None, None, :]
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -120,11 +129,21 @@ def attention(
     q_offset: int = 0,
     sliding_window: Optional[int] = None,
     softmax_dtype=jnp.float32,
+    attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = attend
 ) -> jax.Array:
     """Dispatch mirroring the reference's flash/ring/Core selection
     (``modeling_llama.py:482-489``).  Falls back to ``core_attention`` (with a
     one-time warning) if the requested kernel is unavailable, so reference
-    configs with ``fusions.flash_attention: true`` still run."""
+    configs with ``fusions.flash_attention: true`` still run.
+
+    ``attention_mask`` (padding) is only supported by the core path: the
+    Pallas flash kernel and the ring body skip masked blocks structurally, so
+    a padded batch falls back to core with a one-time warning.  Right-padded
+    batches under a causal mask don't need it — pads are never attended by
+    real tokens — so pretraining/packed-SFT never hits the fallback."""
+    if attention_mask is not None and impl in ("flash", "ring"):
+        _warn_fallback(f"{impl}+attention_mask")
+        impl = "core"
     if impl == "flash":
         try:
             from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
@@ -155,5 +174,7 @@ def attention(
         causal=causal,
         q_offset=q_offset,
         sliding_window=sliding_window,
+        bias=(None if attention_mask is None
+              else padding_mask_bias(attention_mask, softmax_dtype)),
         softmax_dtype=softmax_dtype,
     )

@@ -294,7 +294,8 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpret):
+def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpret,
+                dlse=None):
     q, k, v, o, lse = res  # q [b, nh, sq, d]; k/v [b, nkv, skv, d]
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
@@ -303,6 +304,10 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
 
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [b, nh, sq]
+    if dlse is not None:
+        # lse exposed as a differentiable output (ring merge): d lse / d s = p,
+        # so ds = p*(dp - delta + dlse) — fold dlse into the delta operand
+        delta = delta - dlse
     delta = jnp.broadcast_to(delta[..., None], (b, nh, sq, SUBLANES))
 
     common = dict(sm_scale=sm_scale, causal=causal, window=window, q_offset=q_offset,
@@ -396,6 +401,92 @@ def _flash_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# -- lse-exposing variant (the ring-attention building block) ----------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+    """Like ``_flash`` but returns ``(o, lse)`` with lse differentiable.
+
+    ``lse [b, nh, sq]`` is the per-row logsumexp of the (scaled, masked)
+    scores; rows with no visible key carry ``NEG_INF`` and o = 0.  Exposing it
+    lets callers merge partial attention over KV chunks (context-parallel ring)
+    with exact autodiff: the merge is plain JAX, and this op's vjp folds the
+    lse cotangent into the kernel's delta operand.
+    """
+    o, lse = _fwd_pallas(
+        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+    )
+    return o, lse[..., 0]
+
+
+def _flash_lse_fwd(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+    o, lse = _fwd_pallas(
+        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+    )
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
+    do, dlse = g
+    q = res[0]
+    return _bwd_pallas(
+        res, do, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret, dlse=dlse,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_tileable(sq: int, skv: int, d: int, nh: int, nkv: int,
+                   block_q: Optional[int] = None,
+                   block_kv: Optional[int] = None) -> bool:
+    """True when these shapes can run the Pallas kernels (no fallback)."""
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    return _tileable(sq, skv, d, bq, bkv) and nh % nkv == 0
+
+
+def flash_attention_with_lse(
+    q: jax.Array,  # [b, sq, nh, d]
+    k: jax.Array,  # [b, skv, nkv, d]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    q_offset: int = 0,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """(o [b, sq, nh, d], lse [b, nh, sq]) — the ring building block.
+
+    No core fallback: callers must check ``flash_tileable`` first (the ring
+    body needs lse, which core attention does not produce).
+    """
+    b, sq, nh, d = q.shape
+    skv, nkv = k.shape[1], k.shape[2]
+    # NOTE: unlike ``flash_attention``, sliding_window is honored even when
+    # causal=False — the ring's fully-visible past chunks need exactly that
+    # (window mask at a static relative offset, no causal mask)
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    if not _tileable(sq, skv, d, bq, bkv) or nh % nkv != 0:
+        raise ValueError(
+            f"flash_attention_with_lse: shapes not tileable "
+            f"(sq={sq}, skv={skv}, d={d}, nh={nh}, nkv={nkv})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse = _flash_lse(qt, kt, vt, causal, sliding_window, q_offset, bq, bkv,
+                        interpret)
+    return jnp.swapaxes(o, 1, 2), lse
 
 
 def flash_attention(

@@ -58,6 +58,31 @@ class AdamWConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class EMAConfig:
+    """Weight EMA (the reference's NeMo ``EMA`` callback wired from
+    ``exp_manager.ema``, ``utils/exp_manager.py:298-305``).  TPU-native the
+    EMA tree lives INSIDE the optimizer state so it is jitted, donated,
+    ZeRO-1-sharded, and checkpointed with everything else."""
+
+    decay: float = 0.9999
+    apply_every_n_steps: int = 1
+    start_step: int = 0
+    evaluate_ema_weights_instead: bool = False
+
+    @classmethod
+    def from_config(cls, ema_cfg: dict[str, Any]) -> "EMAConfig":
+        e = dict(ema_cfg or {})
+        return cls(
+            decay=float(e.get("decay", 0.9999)),
+            apply_every_n_steps=int(e.get("apply_ema_every_n_steps", 1)),
+            start_step=int(e.get("start_step", 0)),
+            evaluate_ema_weights_instead=bool(
+                e.get("evaluate_ema_weights_instead", False)
+            ),
+        )
+
+
 def _path_str(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path).lower()
 
@@ -74,9 +99,10 @@ def decay_mask(params, cfg: AdamWConfig):
     return jax.tree_util.tree_map_with_path(leaf_mask, params)
 
 
-def init_opt_state(params, policy: DtypePolicy | None = None):
-    """Opt state: step counter, fp32 moments, and fp32 master weights when the
-    params themselves are stored in a lower precision."""
+def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = False):
+    """Opt state: step counter, fp32 moments, fp32 master weights when the
+    params themselves are stored in a lower precision, and (optionally) the
+    weight-EMA tree."""
     policy = policy or DtypePolicy()
     odt = policy.optimizer_dtype
 
@@ -90,6 +116,8 @@ def init_opt_state(params, policy: DtypePolicy | None = None):
     }
     if jnp.dtype(policy.param_dtype) != jnp.dtype(odt):
         state["master"] = jax.tree_util.tree_map(lambda x: x.astype(odt), params)
+    if ema:
+        state["ema"] = jax.tree_util.tree_map(lambda x: x.astype(odt), params)
     return state
 
 
@@ -108,6 +136,7 @@ def adamw_update(
     cfg: AdamWConfig,
     policy: DtypePolicy | None = None,
     trainable_mask=None,
+    ema_cfg: Optional[EMAConfig] = None,
 ):
     """One AdamW step. Returns (new_params, new_opt_state, metrics).
 
@@ -160,6 +189,18 @@ def adamw_update(
     }
     if "master" in opt_state:
         new_state["master"] = jax.tree_util.tree_map(lambda x: x.astype(odt), new_master)
+    if "ema" in opt_state:
+        e = ema_cfg or EMAConfig()
+        apply = jnp.logical_and(
+            step >= e.start_step,
+            jnp.remainder(step, e.apply_every_n_steps) == 0,
+        )
+        d = jnp.where(apply, e.decay, 1.0)
+        new_state["ema"] = jax.tree_util.tree_map(
+            lambda old, p: (d * old.astype(jnp.float32)
+                            + (1.0 - d) * p.astype(jnp.float32)).astype(odt),
+            opt_state["ema"], new_master,
+        )
     new_params = jax.tree_util.tree_map(lambda x, p: x.astype(p.dtype), new_master, params)
     metrics = {"grad_norm": gnorm}
     return new_params, new_state, metrics
@@ -202,7 +243,7 @@ def zero1_leaf_spec(spec: P, shape, mesh: Mesh, dp_axes=("data", "expert")) -> P
 
 def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
                     policy: DtypePolicy | None = None,
-                    zero1_exclude: tuple = ()):
+                    zero1_exclude: tuple = (), ema: bool = False):
     """Spec pytree matching ``init_opt_state`` output.
 
     ``zero1_exclude`` names path substrings whose moments keep the plain param
@@ -232,4 +273,6 @@ def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
     out = {"step": P(), "mu": moment_specs, "nu": moment_specs}
     if jnp.dtype(policy.param_dtype) != jnp.dtype(policy.optimizer_dtype):
         out["master"] = moment_specs
+    if ema:
+        out["ema"] = moment_specs
     return out

@@ -66,8 +66,13 @@ def constrain(x, spec: Optional[P], mesh: Optional[Mesh] = None):
         return x
     try:
         return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        # no context mesh (plain jit under the legacy `with mesh:` manager)
+    except RuntimeError as e:
+        # ONLY the no-context-mesh case falls through (plain jit under the
+        # legacy `with mesh:` manager); a genuine spec error (bad axis, rank
+        # mismatch — ValueError) must propagate, not silently return
+        # unconstrained activations
+        if "non-empty mesh in context" not in str(e):
+            raise
         m = mesh or active_mesh()
         if m is None:
             return x

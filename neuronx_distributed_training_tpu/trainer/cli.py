@@ -42,6 +42,41 @@ def parse_overrides(pairs: list[str]) -> dict:
     return out
 
 
+def maybe_init_distributed(jax) -> bool:
+    """Multi-host rendezvous from the cluster environment.
+
+    The reference's ``train_setup.sh`` cases (SLURM ``SLURM_NODEID``/nslookup
+    IP list, MPI ``OMPI_COMM_WORLD_RANK``, reference ``train_setup.sh:5-40``)
+    collapse to one call: ``jax.distributed.initialize()`` auto-detects SLURM,
+    Open MPI, and TPU-pod metadata and performs the coordinator handshake —
+    explicit env (``COORDINATOR_ADDRESS``/``NXDT_*``) overrides detection.
+    """
+    env = os.environ
+    # fully-explicit rendezvous only when ALL THREE NXDT_* vars are set; a
+    # bare COORDINATOR_ADDRESS keeps the no-arg auto-detect path (which reads
+    # SLURM_PROCID / OMPI ranks itself) — defaulting num_processes=1 there
+    # would silently split a pod into single-host runs
+    if (env.get("NXDT_COORDINATOR") and env.get("NXDT_NUM_PROCESSES")
+            and env.get("NXDT_PROCESS_ID")):
+        jax.distributed.initialize(
+            coordinator_address=env["NXDT_COORDINATOR"],
+            num_processes=int(env["NXDT_NUM_PROCESSES"]),
+            process_id=int(env["NXDT_PROCESS_ID"]),
+        )
+        return True
+    slurm = int(env.get("SLURM_NTASKS", "1") or 1) > 1
+    ompi = int(env.get("OMPI_COMM_WORLD_SIZE", "1") or 1) > 1
+    explicit_env = bool(env.get("COORDINATOR_ADDRESS")
+                        or env.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if slurm or ompi or explicit_env:
+        jax.distributed.initialize()  # jax's built-in cluster auto-detection
+        logger.info(
+            "distributed: process %d/%d", jax.process_index(), jax.process_count()
+        )
+        return True
+    return False
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", required=True, help="YAML config (reference schema)")
@@ -70,9 +105,7 @@ def main() -> None:
         jax.config.update("jax_compilation_cache_dir", args.compilation_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    # multi-host init when a cluster environment is detectable
-    if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize()
+    maybe_init_distributed(jax)
 
     from neuronx_distributed_training_tpu.config.loader import load_config
     from neuronx_distributed_training_tpu.trainer.loop import Trainer

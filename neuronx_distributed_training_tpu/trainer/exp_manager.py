@@ -38,6 +38,11 @@ class ExpManager:
         profile_num_steps: int = 3,
         create_wandb_logger: bool = False,
         wandb_kwargs: Optional[dict] = None,
+        create_mlflow_logger: bool = False,
+        mlflow_kwargs: Optional[dict] = None,
+        log_files: bool = True,
+        log_local_rank_0_only: bool = False,
+        log_global_rank_0_only: bool = False,
     ):
         base = Path(exp_dir) / name
         if version is None:
@@ -84,6 +89,51 @@ class ExpManager:
                 )
             except Exception as e:  # noqa: BLE001 — W&B is optional
                 logger.warning("W&B logger unavailable: %s", e)
+        self._mlflow = None
+        if create_mlflow_logger:
+            # reference create_mlflow_logger/mlflow_logger_kwargs
+            # (utils/exp_manager.py:133-135, 223-228); soft-gated import
+            try:
+                import mlflow
+
+                kw = dict(mlflow_kwargs or {})
+                mlflow.set_tracking_uri(
+                    kw.pop("tracking_uri", f"file:{self.log_dir / 'mlruns'}")
+                )
+                mlflow.set_experiment(kw.pop("experiment_name", name))
+                self._mlflow = mlflow
+                self._mlflow_run = mlflow.start_run(run_name=version)
+            except Exception as e:  # noqa: BLE001 — MLflow is optional
+                logger.warning("MLflow logger unavailable: %s", e)
+        self._file_handler = None
+        if log_files:
+            self._file_handler = self._setup_rank_log_file(
+                log_local_rank_0_only, log_global_rank_0_only
+            )
+
+    def _setup_rank_log_file(self, local_rank_0_only: bool,
+                             global_rank_0_only: bool):
+        """Per-rank log files (reference ``exp_manager.py:249-268``:
+        ``nemo_log_globalrank-G_localrank-L.txt`` with rank-0-only gating)."""
+        if local_rank_0_only and global_rank_0_only:
+            raise ValueError(
+                "Cannot set both log_local_rank_0_only and "
+                "log_global_rank_0_only; pick one or neither."
+            )
+        import jax
+
+        g = jax.process_index()
+        # one process per host on TPU: local rank == 0 within its host
+        local = 0
+        if (global_rank_0_only and g != 0) or (local_rank_0_only and local != 0):
+            return None
+        path = self.log_dir / f"nxdt_log_globalrank-{g}_localrank-{local}.txt"
+        handler = logging.FileHandler(path)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+        ))
+        logging.getLogger().addHandler(handler)
+        return handler
 
     @classmethod
     def from_config(cls, cfg: dict[str, Any], global_batch_size: int = 1) -> "ExpManager":
@@ -103,6 +153,11 @@ class ExpManager:
             profile_num_steps=int(em.get("profile_num_steps", 3)),
             create_wandb_logger=bool(em.get("create_wandb_logger", False)),
             wandb_kwargs=dict(em.get("wandb_logger_kwargs", {}) or {}),
+            create_mlflow_logger=bool(em.get("create_mlflow_logger", False)),
+            mlflow_kwargs=dict(em.get("mlflow_logger_kwargs", {}) or {}),
+            log_files=bool(em.get("log_files", True)),
+            log_local_rank_0_only=bool(em.get("log_local_rank_0_only", False)),
+            log_global_rank_0_only=bool(em.get("log_global_rank_0_only", False)),
         )
 
     # -- profiling (jax.profiler -> TensorBoard profile plugin; the TPU-native
@@ -153,6 +208,8 @@ class ExpManager:
                 self._tb.add_scalar(k, v, step)
         if self._wandb is not None:
             self._wandb.log(flat, step=step)
+        if self._mlflow is not None:
+            self._mlflow.log_metrics(flat, step=step)
         with open(self._metrics_file, "a") as f:
             f.write(json.dumps({"step": step, **flat}) + "\n")
 
@@ -167,6 +224,12 @@ class ExpManager:
             self._tb.close()
         if self._wandb is not None:
             self._wandb.finish()
+        if self._mlflow is not None:
+            self._mlflow.end_run()
+        if self._file_handler is not None:
+            logging.getLogger().removeHandler(self._file_handler)
+            self._file_handler.close()
+            self._file_handler = None
 
 
 def _is_scalar(v: Any) -> bool:

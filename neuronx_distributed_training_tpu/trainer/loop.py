@@ -32,6 +32,7 @@ from neuronx_distributed_training_tpu.data import DataModule, SyntheticDataModul
 from neuronx_distributed_training_tpu.models import llama
 from neuronx_distributed_training_tpu.optim.adamw import (
     AdamWConfig,
+    EMAConfig,
     init_opt_state,
     opt_state_specs,
 )
@@ -47,6 +48,23 @@ from neuronx_distributed_training_tpu.trainer.step import (
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
 logger = logging.getLogger(__name__)
+
+
+def parse_max_time(value: Any) -> Optional[float]:
+    """``trainer.max_time`` -> seconds.  Accepts NeMo's ``DD:HH:MM:SS`` string
+    (reference ``StatelessTimer``, ``examples/training.py:65-69``) or a number
+    of seconds.  "Stateless": each (re)start gets the full budget — elapsed
+    time is deliberately NOT carried through checkpoints, so a requeued SLURM
+    job trains for another ``max_time`` instead of exiting immediately."""
+    if value in (None, "", 0):
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    parts = [int(p) for p in str(value).split(":")]
+    if len(parts) != 4:
+        raise ValueError(f"trainer.max_time must be DD:HH:MM:SS, got {value!r}")
+    d, h, m, s = parts
+    return float(((d * 24 + h) * 60 + m) * 60 + s)
 
 
 @dataclasses.dataclass
@@ -71,6 +89,7 @@ class Trainer:
     max_steps: int
     step: int = 0
     pre_fit: Optional[Callable] = None  # runs once before the loop (DPO ref pass)
+    ema_cfg: Optional[Any] = None  # optim.adamw.EMAConfig when EMA is enabled
 
     # -- assembly -----------------------------------------------------------
 
@@ -227,8 +246,18 @@ class Trainer:
 
             # eval reuses the pipelined loss: under pp the layer stack lives in
             # the pipeline layout (interleaved when vp>1), so the plain forward
-            # cannot run on it; val batches are gbs-shaped, satisfying the
-            # microbatch split
+            # cannot run on it; val batches must be gbs-shaped to satisfy the
+            # microbatch split — checked here instead of failing deep in
+            # shard_map
+            if val_data_module is not None:
+                vgbs = getattr(val_data_module, "global_batch_size", None)
+                if vgbs is not None and int(vgbs) != int(sched["global_batch_size"]):
+                    raise ValueError(
+                        f"under pipeline parallelism validation batches must "
+                        f"match the train global_batch_size "
+                        f"{sched['global_batch_size']} (val module has {vgbs}): "
+                        f"the pipelined eval loss microbatches the same way"
+                    )
             eval_loss_fn = loss_fn
             pspecs = specs_fn(pipeline=True)
             if vp > 1:
@@ -244,11 +273,20 @@ class Trainer:
         opt_block = dict((cfg.get("model", {}) or {}).get("optim", {}) or {})
         opt_cfg = AdamWConfig.from_config(opt_block, cfg.get("trainer", {}))
         zero1 = bool(cfg.get("distributed_strategy", {}).get("zero1", True))
-        opt_state = init_opt_state(params, policy)
+        # weight EMA (reference exp_manager.ema -> NeMo EMA callback,
+        # utils/exp_manager.py:298-305); lives inside the optimizer state
+        ema_block = dict((cfg.get("exp_manager", {}) or {}).get("ema", {}) or {})
+        ema_cfg = (
+            EMAConfig.from_config(ema_block) if ema_block.get("enable") else None
+        )
+        opt_state = init_opt_state(params, policy, ema=ema_cfg is not None)
         # full ZeRO-1 including the embedding: the pipeline embed hooks use the
         # one-hot matmul form (ops.linear.apply_embedding via_matmul) so no
         # gather-transpose scatter reaches the partitioner under manual pipe
-        ospecs = opt_state_specs(params, pspecs, mesh, zero1=zero1, policy=policy)
+        ospecs = opt_state_specs(
+            params, pspecs, mesh, zero1=zero1, policy=policy,
+            ema=ema_cfg is not None,
+        )
 
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
         lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
@@ -256,8 +294,14 @@ class Trainer:
             loss_fn, opt_cfg, lr_schedule, policy,
             num_microbatches=num_micro_in_step,
             trainable_mask=trainable,
+            ema_cfg=ema_cfg,
         )
-        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs)
+        # donation is disabled under EMA: donating an opt state that carries
+        # the EMA tree trips an INVALID_ARGUMENT in the (tunnelled) TPU
+        # runtime (plain jit and donate=False both run clean); EMA already
+        # costs +4 bytes/param, the lost aliasing is the smaller evil
+        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs,
+                               donate=ema_cfg is None)
         eval_fn = jax.jit(make_eval_step(eval_loss_fn)) if val_data_module else None
 
         # shard initial state onto the mesh
@@ -358,7 +402,7 @@ class Trainer:
             params=params, opt_state=opt_state, param_specs=pspecs, opt_specs=ospecs,
             train_step=jstep, eval_step=eval_fn, data_module=data_module,
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
-            max_steps=max_steps, pre_fit=pre_fit,
+            max_steps=max_steps, pre_fit=pre_fit, ema_cfg=ema_cfg,
         )
 
     # -- resume -------------------------------------------------------------
@@ -383,12 +427,32 @@ class Trainer:
     # -- the loop -----------------------------------------------------------
 
     def fit(self) -> dict[str, float]:
+        import signal
+        import time as _time
+
         cfg_t = dict(self.cfg.get("trainer", {}) or {})
         val_interval = int(cfg_t.get("val_check_interval", 0) or 0)
         limit_val = int(cfg_t.get("limit_val_batches", 10) or 10)
         ck_every = (
             self.checkpointer.config.every_n_train_steps if self.checkpointer else 0
         )
+        max_time = parse_max_time(cfg_t.get("max_time"))
+        t_start = _time.monotonic()
+
+        # preemption hook: SIGTERM (SLURM preemption / spot reclaim) requests a
+        # graceful stop — checkpoint at the next step boundary, then exit clean
+        # so resume_if_exists continues the run (reference: Lightning's
+        # preemption plugin + SLURM requeue, train_setup.sh:28-29)
+        stop_requested = {"reason": None}
+
+        def _on_sigterm(signum, frame):
+            stop_requested["reason"] = "SIGTERM (preemption)"
+
+        old_handler = None
+        try:
+            old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not in the main thread (tests); preemption hook disabled
 
         # pre_fit BEFORE resume: the DPO reference pass must see the frozen
         # initial policy, not resumed weights (see pre_fit docstring)
@@ -410,6 +474,9 @@ class Trainer:
                         self.params, self.opt_state, batch, key
                     )
                     self.step += 1
+                    if max_time is not None and stop_requested["reason"] is None:
+                        if _time.monotonic() - t_start > max_time:
+                            stop_requested["reason"] = f"max_time {cfg_t.get('max_time')}"
                     # host sync ONLY at logging/validation/checkpoint
                     # boundaries: between them the loop keeps dispatching
                     # ahead of the device (the reference batches metric
@@ -418,6 +485,7 @@ class Trainer:
                     boundary = (
                         self.step % log_every == 0
                         or self.step == self.max_steps
+                        or stop_requested["reason"] is not None
                         or (val_interval and self.step % val_interval == 0)
                         or (ck_every and self.step % ck_every == 0)
                     )
@@ -438,9 +506,24 @@ class Trainer:
                         )
                     if ck_every and self.step % ck_every == 0:
                         self.save_checkpoint(last_metrics)
-                if ck_every and self.checkpointer is not None:
+                    if stop_requested["reason"] is not None:
+                        logger.warning(
+                            "stopping at step %d: %s — checkpointing for resume",
+                            self.step, stop_requested["reason"],
+                        )
+                        if self.checkpointer is not None and (
+                            not ck_every or self.step % ck_every != 0
+                        ):
+                            self.save_checkpoint(last_metrics)
+                        break
+                if (ck_every and self.checkpointer is not None
+                        and stop_requested["reason"] is None):
                     self.save_checkpoint(last_metrics)  # final save
         finally:
+            if old_handler is not None:
+                import signal as _signal
+
+                _signal.signal(_signal.SIGTERM, old_handler)
             if self.checkpointer is not None:
                 self.checkpointer.wait()
                 self.checkpointer.close()
@@ -448,12 +531,21 @@ class Trainer:
         return last_metrics
 
     def validate(self, limit_batches: int) -> float:
+        params = self.params
+        if (self.ema_cfg is not None
+                and self.ema_cfg.evaluate_ema_weights_instead
+                and "ema" in self.opt_state):
+            # reference evaluate_ema_weights_instead: swap in the averaged
+            # weights for validation only
+            params = jax.tree_util.tree_map(
+                lambda e, p: e.astype(p.dtype), self.opt_state["ema"], self.params
+            )
         losses = []
         it = self.val_data_module.sharded_batches(self.mesh)
         for i, batch in enumerate(it):
             if i >= limit_batches:
                 break
-            m = self.eval_step(self.params, batch, jax.random.PRNGKey(0))
+            m = self.eval_step(params, batch, jax.random.PRNGKey(0))
             losses.append(float(m["val_loss"]))
         return float(np.mean(losses)) if losses else float("nan")
 

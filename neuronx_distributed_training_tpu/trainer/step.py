@@ -54,6 +54,7 @@ def make_train_step(
     num_microbatches: int = 1,
     log_param_norm: bool = False,
     trainable_mask: Any = None,  # peft.lora.trainable_mask for LoRA freeze
+    ema_cfg: Any = None,  # optim.adamw.EMAConfig; state must carry an "ema" tree
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``."""
@@ -104,7 +105,7 @@ def make_train_step(
         lr = lr_schedule(opt_state["step"])
         new_params, new_opt_state, opt_metrics = adamw_update(
             params, grads, opt_state, lr, opt_cfg, policy,
-            trainable_mask=trainable_mask,
+            trainable_mask=trainable_mask, ema_cfg=ema_cfg,
         )
         metrics = {
             "loss": loss,

@@ -114,3 +114,32 @@ def test_overrides(tmp_path):
     cfg = load_config(p, overrides={"model.num_layers": 2, "trainer.max_steps": 5})
     assert cfg.model.num_layers == 2
     assert cfg.model.optim.sched.max_steps == 5
+
+
+def test_all_shipped_configs_load_and_build():
+    """Every examples/conf YAML must load through the reference-schema loader
+    and produce a valid model config + batch schedule (catches key drift)."""
+    import glob
+
+    from neuronx_distributed_training_tpu.config.loader import (
+        batch_schedule,
+        load_config,
+    )
+    from neuronx_distributed_training_tpu.trainer.loop import build_model
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    configs = sorted(glob.glob("examples/conf/*.yaml"))
+    assert len(configs) >= 20  # parity-class config pack
+    for path in configs:
+        cfg = load_config(path)
+        model_cfg, loss_fn, init_fn, specs_fn = build_model(cfg, DtypePolicy())
+        assert model_cfg.num_layers > 0, path
+        ds = dict(cfg.get("distributed_strategy", {}) or {})
+        n_needed = (int(ds.get("tensor_model_parallel_size", 1))
+                    * int(ds.get("pipeline_model_parallel_size", 1))
+                    * int(ds.get("context_parallel_size", 1)))
+        sched = batch_schedule(cfg, n_needed)
+        assert sched["num_microbatches"] >= 1, path
+        # specs build without touching devices
+        specs = specs_fn()
+        assert "layers" in specs, path

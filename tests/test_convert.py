@@ -14,6 +14,10 @@ from neuronx_distributed_training_tpu.tools.convert import (
 )
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # multi-minute parity tests; CI fast tier deselects
+
 FP32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
                    softmax_dtype=jnp.float32)
 

@@ -16,6 +16,10 @@ from neuronx_distributed_training_tpu.data.build import (
 )
 from neuronx_distributed_training_tpu.trainer.loop import Trainer, train
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # fit()-based integration tests; CI fast tier deselects
+
 
 def base_cfg(tmp_path, **data):
     return load_config({

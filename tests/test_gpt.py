@@ -81,6 +81,7 @@ class TestVariants:
         assert cfg.sequence_parallel
 
 
+@pytest.mark.slow
 class TestShardedGPT:
     def test_tp_parity(self, devices8):
         cfg = gpt.GPTConfig(**BASE, num_query_groups=2, activation="swiglu")
@@ -110,3 +111,52 @@ class TestShardedGPT:
         cfg = gpt.GPTConfig(**BASE)
         specs = gpt.param_specs(cfg, pipeline=True)
         assert specs["layers"]["attn"]["qkv"]["w"][0] == "pipe"
+
+
+class TestGPTAttentionMask:
+    def test_left_padded_matches_unpadded(self):
+        from neuronx_distributed_training_tpu.models import gpt as gpt_mod
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                           softmax_dtype=jnp.float32)
+        for pe in ("rope", "learned_absolute"):
+            cfg = gpt_mod.GPTConfig(
+                vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_position_embeddings=32,
+                position_embedding_type=pe,
+                activations_checkpoint_granularity=None,
+            )
+            params = gpt_mod.init_params(jax.random.PRNGKey(0), cfg, fp32)
+            ids = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 3, 64)
+            ref, _ = gpt_mod.forward(params, {"input_ids": ids}, cfg, fp32)
+            pad = 4
+            padded = jnp.concatenate([jnp.zeros((1, pad), ids.dtype), ids], 1)
+            mask = jnp.concatenate(
+                [jnp.zeros((1, pad), jnp.int32), jnp.ones((1, 12), jnp.int32)], 1)
+            out, _ = gpt_mod.forward(
+                params, {"input_ids": padded, "attention_mask": mask}, cfg, fp32)
+            np.testing.assert_allclose(
+                np.asarray(out[:, pad:]), np.asarray(ref), rtol=2e-5, atol=2e-5,
+                err_msg=f"position_embedding_type={pe}")
+
+    def test_mask_folds_into_loss(self):
+        from neuronx_distributed_training_tpu.models import gpt as gpt_mod
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                           softmax_dtype=jnp.float32)
+        cfg = gpt_mod.GPTConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_attention_heads=4,
+            max_position_embeddings=32, activations_checkpoint_granularity=None,
+        )
+        params = gpt_mod.init_params(jax.random.PRNGKey(0), cfg, fp32)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 3, 64)
+        mask = jnp.ones((2, 16), jnp.int32).at[:, :6].set(0)
+        loss_a, _ = gpt_mod.forward(
+            params, {"input_ids": ids, "labels": ids, "attention_mask": mask},
+            cfg, fp32)
+        loss_b, _ = gpt_mod.forward(
+            params, {"input_ids": ids, "labels": ids, "attention_mask": mask,
+                     "loss_mask": mask.astype(jnp.float32)}, cfg, fp32)
+        np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)

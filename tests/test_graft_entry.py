@@ -10,6 +10,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import __graft_entry__ as graft  # noqa: E402
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # multi-minute parity tests; CI fast tier deselects
+
 
 def test_entry_compiles(devices8):
     fn, args = graft.entry()

@@ -108,3 +108,18 @@ class TestMixtralSharded:
         g = grads["layers"]["mlp"]["experts"]["down"]
         rg = ref_grads["layers"]["mlp"]["experts"]["down"]
         np.testing.assert_allclose(np.asarray(g), np.asarray(rg), rtol=1e-3, atol=1e-5)
+
+
+def test_mixtral_left_padded_matches_unpadded():
+    """attention_mask: left-padded batch matches unpadded on real positions."""
+    params = mixtral.init_params(jax.random.PRNGKey(0), CFG, FP32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 3, 128)
+    ref, _ = mixtral.forward(params, {"input_ids": ids}, CFG, FP32)
+    pad = 4
+    padded = jnp.concatenate([jnp.zeros((1, pad), ids.dtype), ids], 1)
+    mask = jnp.concatenate(
+        [jnp.zeros((1, pad), jnp.int32), jnp.ones((1, 12), jnp.int32)], 1)
+    out, _ = mixtral.forward(
+        params, {"input_ids": padded, "attention_mask": mask}, CFG, FP32)
+    np.testing.assert_allclose(
+        np.asarray(out[:, pad:]), np.asarray(ref), rtol=2e-5, atol=2e-5)

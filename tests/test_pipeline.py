@@ -14,6 +14,10 @@ from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mes
 from neuronx_distributed_training_tpu.parallel.pipeline import pipeline_loss, stage_layer_slice
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # multi-minute parity tests; CI fast tier deselects
+
 FP32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
                    softmax_dtype=jnp.float32)
 

@@ -11,6 +11,10 @@ from neuronx_distributed_training_tpu.parallel import sharding as shd
 from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
 from neuronx_distributed_training_tpu.parallel.ring_attention import ring_attention
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # multi-minute parity tests; CI fast tier deselects
+
 
 def make_qkv(key, b=2, s=64, h=4, kvh=None, d=16, dtype=jnp.float32):
     kvh = kvh or h
@@ -189,3 +193,87 @@ class TestRingNumerics:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
         )
+
+
+class TestFlashRing:
+    """The Pallas-fused ring body (tileable shapes -> _ring_local_flash)."""
+
+    @pytest.fixture(scope="class")
+    def cp2_mesh(self):
+        return build_mesh(MeshConfig(context_parallel_size=2))
+
+    def _tileable_qkv(self, key, b=4, s=512, h=2, kvh=2, d=128):
+        return make_qkv(key, b=b, s=s, h=h, kvh=kvh, d=d)
+
+    def test_flash_path_selected_and_matches_core(self, cp2_mesh):
+        from neuronx_distributed_training_tpu.ops.flash_attention import flash_tileable
+
+        q, k, v = self._tileable_qkv(jax.random.PRNGKey(0))
+        assert flash_tileable(256, 256, 128, 2, 2)  # s/cp local shapes tile
+        ref = core_attention(q, k, v, causal=True)
+        with cp2_mesh, shd.use_mesh(cp2_mesh):
+            out = jax.jit(lambda *a: ring_attention(*a, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_ring_grads_match_core(self, cp2_mesh):
+        q, k, v = self._tileable_qkv(jax.random.PRNGKey(1))
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.square(ring_attention(q, k, v, causal=True)))
+
+        def loss_core(q, k, v):
+            return jnp.sum(jnp.square(core_attention(q, k, v, causal=True)))
+
+        ref_grads = jax.grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        with cp2_mesh, shd.use_mesh(cp2_mesh):
+            grads = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for g, rg, name in zip(grads, ref_grads, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(rg), rtol=5e-4, atol=5e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_flash_ring_gqa(self, cp2_mesh):
+        q, k, v = self._tileable_qkv(jax.random.PRNGKey(2), h=4, kvh=2)
+        ref = core_attention(q, k, v, causal=True)
+        with cp2_mesh, shd.use_mesh(cp2_mesh):
+            out = jax.jit(lambda *a: ring_attention(*a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_ring_sliding_window(self, cp2_mesh):
+        q, k, v = self._tileable_qkv(jax.random.PRNGKey(3))
+        ref = core_attention(q, k, v, causal=True, sliding_window=300)
+        with cp2_mesh, shd.use_mesh(cp2_mesh):
+            out = jax.jit(
+                lambda *a: ring_attention(*a, causal=True, sliding_window=300)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_ring_non_causal(self, cp2_mesh):
+        q, k, v = self._tileable_qkv(jax.random.PRNGKey(4))
+        ref = core_attention(q, k, v, causal=False)
+        with cp2_mesh, shd.use_mesh(cp2_mesh):
+            out = jax.jit(lambda *a: ring_attention(*a, causal=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_non_causal_window_is_ignored_like_core():
+    """The window is causal-only across the stack (core_attention applies it
+    inside the causal mask; flash drops it when causal=False): ring must
+    match, not invent non-causal windowing the other impls don't have."""
+    mesh = build_mesh(MeshConfig(context_parallel_size=2))
+    q, k, v = make_qkv(jax.random.PRNGKey(21), b=4, s=512, h=2, kvh=2, d=128)
+    ref = core_attention(q, k, v, causal=False, sliding_window=300)
+    np.testing.assert_allclose(  # core itself ignores the window non-causally
+        np.asarray(ref), np.asarray(core_attention(q, k, v, causal=False)),
+        atol=1e-6)
+    with mesh, shd.use_mesh(mesh):
+        out = jax.jit(
+            lambda *a: ring_attention(*a, causal=False, sliding_window=300)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -9,6 +9,10 @@ import pytest
 from neuronx_distributed_training_tpu.config.loader import load_config
 from neuronx_distributed_training_tpu.trainer.loop import Trainer, train
 
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # fit()-based integration tests; CI fast tier deselects
+
 
 def tiny_cfg(tmp_path, max_steps=5, **over):
     cfg = {
@@ -209,3 +213,54 @@ def test_orpo_trainer_end_to_end(tmp_path, devices8):
     assert np.isfinite(m["loss"])
     assert "orpo_log_odds" in m
     assert "reference_chosen_logps" not in dm.arrays
+
+
+def test_ema_weights_tracked_and_evaluated(tmp_path, devices8):
+    """exp_manager.ema: EMA tree in opt state, decays toward params, and
+    validate() can evaluate with EMA weights instead."""
+    from neuronx_distributed_training_tpu.data import SyntheticDataModule
+
+    cfg = tiny_cfg(tmp_path, max_steps=3,
+                   trainer={"max_steps": 3, "log_every_n_steps": 1,
+                            "val_check_interval": 3, "limit_val_batches": 1})
+    cfg["exp_manager"]["ema"] = {"enable": True, "decay": 0.5,
+                                 "evaluate_ema_weights_instead": True}
+    cfg = load_config(dict(cfg))
+    val_dm = SyntheticDataModule(vocab_size=128, seq_len=32, global_batch_size=8, seed=9)
+    t = Trainer.from_config(cfg, val_data_module=val_dm, enable_checkpointing=False)
+    assert "ema" in t.opt_state
+    ema0 = np.asarray(t.opt_state["ema"]["layers"]["attn"]["qkv"]["w"]).copy()
+    m = t.fit()
+    assert np.isfinite(m["val_loss"])
+    ema1 = np.asarray(t.opt_state["ema"]["layers"]["attn"]["qkv"]["w"])
+    w1 = np.asarray(t.params["layers"]["attn"]["qkv"]["w"], dtype=np.float32)
+    assert not np.array_equal(ema0, ema1)  # EMA moved
+    # with decay 0.5 over 3 steps, EMA lags params but tracks them
+    assert np.abs(ema1 - w1).max() < np.abs(ema0 - w1).max()
+
+
+def test_max_time_stops_and_checkpoints(tmp_path, devices8):
+    """trainer.max_time: the loop stops early, saves a resumable checkpoint."""
+    cfg = tiny_cfg(tmp_path, max_steps=100000)
+    cfg["trainer"]["max_time"] = "00:00:00:02"  # 2 seconds
+    cfg = load_config(dict(cfg))
+    t = Trainer.from_config(cfg)
+    m = t.fit()
+    assert 0 < t.step < 100000
+    assert t.checkpointer is None or True  # checkpointer was closed in fit
+    # a resumable checkpoint exists at the stop step
+    t2 = Trainer.from_config(load_config(dict(tiny_cfg(tmp_path, max_steps=100000))))
+    assert t2.maybe_resume()
+    assert t2.step == t.step
+
+
+def test_parse_max_time():
+    from neuronx_distributed_training_tpu.trainer.loop import parse_max_time
+
+    assert parse_max_time(None) is None
+    assert parse_max_time("00:01:30:15") == 5415.0
+    assert parse_max_time(90) == 90.0
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        parse_max_time("1:30")
