@@ -95,7 +95,7 @@ def main() -> None:
 
         cfg = mixtral_mod.MixtralConfig.from_config(model_block, ds_block)
         to_native = lambda sd: convert.hf_mixtral_to_native(sd, cfg)
-        to_hf = None  # native->hf mixtral: not yet implemented
+        to_hf = lambda p: convert.native_to_hf_mixtral(p, cfg)
 
     out = Path(args.output)
     if args.direction in ("hf2native", "nnm2native"):
@@ -115,8 +115,6 @@ def main() -> None:
             mgr.wait_until_finished()
         print(f"wrote native checkpoint: {out}/{args.step}/params")
     else:
-        if to_hf is None:
-            raise SystemExit(f"{args.direction} for {args.model} not yet implemented")
         with ocp.CheckpointManager(Path(args.input).absolute()) as mgr:
             step = args.step or mgr.latest_step()
             restored = mgr.restore(step, args=ocp.args.Composite(

@@ -149,15 +149,34 @@ def build_data_module(
 
     if data_prefix:
         # Megatron mmap pretraining (reference megatron/data_module.py:89-130);
-        # data_prefix may be [weight, path, ...] — single-corpus only here
+        # data_prefix may be [weight, path, weight, path, ...] — the blended
+        # multi-corpus form (reference :227-290)
         prefix = data_prefix
         if isinstance(prefix, (list, tuple)):
-            paths = [p for p in prefix if isinstance(p, str)]
-            if len(paths) != 1:
-                raise NotImplementedError(
-                    f"blended data_prefix not supported yet (got {prefix})"
+            items = list(prefix)
+            if len(items) == 1:
+                prefix = items[0]
+            else:
+                try:
+                    if len(items) % 2 != 0:
+                        raise ValueError("odd length")
+                    pairs = [
+                        (float(items[i]), str(items[i + 1]))
+                        for i in range(0, len(items), 2)
+                    ]
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"multi-corpus data_prefix must be [weight, path, "
+                        f"weight, path, ...] pairs with numeric weights, "
+                        f"got {items}"
+                    ) from e
+                from neuronx_distributed_training_tpu.data.modules import (
+                    BlendedMegatronDataModule,
                 )
-            prefix = paths[0]
+
+                return BlendedMegatronDataModule(
+                    pairs, seq, gbs, max_steps=max_steps, seed=seed,
+                ), None
         train = MegatronDataModule(
             prefix, seq, gbs, max_steps=max_steps, seed=seed,
         )

@@ -64,6 +64,67 @@ class MegatronDataModule(DataModule):
         }
 
 
+class BlendedMegatronDataModule(DataModule):
+    """Weighted blend of several mmap corpora (the reference's
+    ``MemoryEfficientBlendableDataset`` flow, ``megatron/data_module.py:
+    227-290``: ``data_prefix: [w1, p1, w2, p2, ...]`` with
+    ``get_datasets_weights_and_num_samples`` sizing each corpus).
+
+    Sampling: a seeded multinomial assigns each global sample index to a
+    corpus (deterministic across restarts — resume-safe the same way the
+    sampler's consumed-samples counter is); the per-corpus inner index is the
+    running count of prior assignments, so every corpus is consumed in order
+    with its own shuffle.
+    """
+
+    labels_pre_shifted = True
+
+    def __init__(
+        self,
+        prefixes_and_weights: Sequence[tuple[float, str | Path]],
+        seq_length: int,
+        global_batch_size: int,
+        *,
+        max_steps: int = 1000,
+        num_samples: Optional[int] = None,
+        seed: int = 1234,
+        **kw: Any,
+    ):
+        from neuronx_distributed_training_tpu.data.megatron import GPTDataset
+
+        if not prefixes_and_weights:
+            raise ValueError("blended data needs at least one (weight, prefix)")
+        n = num_samples or max_steps * global_batch_size
+        w = np.asarray([float(wt) for wt, _ in prefixes_and_weights], np.float64)
+        if np.any(w <= 0):
+            raise ValueError(f"blend weights must be positive, got {w}")
+        w = w / w.sum()
+        rng = np.random.default_rng(seed)
+        self.choices = rng.choice(len(w), size=n, p=w).astype(np.int8)
+        # inner index: per-corpus running count (vectorized one-hot cumsum)
+        self.inner = np.zeros(n, np.int64)
+        counts = []
+        for k in range(len(w)):
+            m = self.choices == k
+            self.inner[m] = np.arange(int(m.sum()))
+            counts.append(int(m.sum()))
+        self.datasets = [
+            GPTDataset(p, seq_length, max(c, 1), seed=seed + 17 * k)
+            for k, ((_, p), c) in enumerate(zip(prefixes_and_weights, counts))
+        ]
+        super().__init__(n, global_batch_size,
+                         input_names=("input_ids", "labels", "loss_mask"), **kw)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        rows = [
+            self.datasets[int(self.choices[i])][int(self.inner[i])] for i in idx
+        ]
+        return {
+            "input_ids": np.stack([r["input_ids"] for r in rows]),
+            "labels": np.stack([r["labels"] for r in rows]),
+        }
+
+
 def load_alignment_records(path: str | Path) -> list[dict[str, Any]]:
     """Load jsonl / json / arrow-dir alignment data
     (reference ``model_alignment_data_module.py:67-92``)."""

@@ -158,6 +158,41 @@ def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     return params
 
 
+def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
+    """Native Mixtral pytree -> HF state_dict (inverse of
+    ``hf_mixtral_to_native``; the reference's nxdt->HF direction,
+    ``hf_nxdt_mixtral_ckpt_converter.py:62-91``)."""
+    lc, e = cfg.llama, cfg.moe.num_experts
+    nh, nkv, d = lc.num_attention_heads, lc.kv_heads, lc.head_size
+    f = lc.intermediate_size
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    if "lm_head" in params:  # tied checkpoints simply have no head tensor
+        out["lm_head.weight"] = _t(params["lm_head"]["w"])
+    for i in range(lc.num_layers):
+        pre = f"model.layers.{i}."
+        lp = _unstack(params["layers"], i)
+        out[pre + "input_layernorm.weight"] = lp["input_norm"]["scale"]
+        out[pre + "post_attention_layernorm.weight"] = lp["post_attn_norm"]["scale"]
+        qkv_t = _t(lp["attn"]["qkv"]["w"])  # [(nh+2kv)d, H]
+        q, k, v = np.split(qkv_t, [nh * d, (nh + nkv) * d], axis=0)
+        out[pre + "self_attn.q_proj.weight"] = q
+        out[pre + "self_attn.k_proj.weight"] = k
+        out[pre + "self_attn.v_proj.weight"] = v
+        out[pre + "self_attn.o_proj.weight"] = _t(lp["attn"]["o"]["w"])
+        out[pre + "block_sparse_moe.gate.weight"] = _t(lp["mlp"]["router"]["w"])
+        gate_up = lp["mlp"]["experts"]["gate_up"]  # [E, H, 2F]
+        down = lp["mlp"]["experts"]["down"]  # [E, F, H]
+        for j in range(e):
+            w1, w3 = np.split(np.asarray(gate_up[j]), [f], axis=1)
+            out[pre + f"block_sparse_moe.experts.{j}.w1.weight"] = _t(w1)
+            out[pre + f"block_sparse_moe.experts.{j}.w3.weight"] = _t(w3)
+            out[pre + f"block_sparse_moe.experts.{j}.w2.weight"] = _t(down[j])
+    return out
+
+
 def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
     """Load an HF checkpoint dir/file (safetensors or torch .bin) as numpy."""
     from pathlib import Path

@@ -1,0 +1,113 @@
+"""Sharding / HLO-consistency assertions — the SPMD sanity tooling.
+
+The reference's correctness tooling is sanitizer-flavored (NCCL/compiler race
+detection, SURVEY.md §5.2).  Under GSPMD the failure mode is different: a bad
+or missing PartitionSpec never crashes — it silently replicates a tensor or
+inserts surprise all-gathers, turning a sharding bug into a perf/memory
+mystery.  These helpers make that failure mode ASSERTABLE:
+
+- ``sharding_report(tree)``: path -> actual committed sharding of every leaf;
+- ``assert_tree_sharding(tree, specs, mesh)``: every leaf's device layout
+  matches the intended spec (catches silent replication after a bad
+  ``device_put`` or a dropped ``with_sharding_constraint``);
+- ``collective_counts(jitted, *args)``: HLO collective census of a compiled
+  step (all-reduce / all-gather / reduce-scatter / collective-permute /
+  all-to-all) so tests pin the expected communication pattern — a TP=2 matmul
+  step that suddenly reports extra all-gathers has a sharding regression.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def sharding_report(tree: Any) -> dict[str, str]:
+    """{leaf path: sharding spec string} for every array leaf."""
+    out: dict[str, str] = {}
+
+    def visit(path, x):
+        sh = getattr(x, "sharding", None)
+        if sh is None:
+            out[_path_str(path)] = "<not a device array>"
+        elif isinstance(sh, NamedSharding):
+            out[_path_str(path)] = str(sh.spec)
+        else:
+            out[_path_str(path)] = repr(sh)
+        return x
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return out
+
+
+def assert_tree_sharding(tree: Any, specs: Any, mesh: Mesh) -> None:
+    """Every leaf of ``tree`` must be laid out as ``NamedSharding(mesh, spec)``.
+
+    Comparison is by device layout (``Sharding.is_equivalent_to``), not spec
+    string equality — ``P('data')`` on a 1-wide data axis and ``P()`` are the
+    same layout and both pass.
+    """
+    errors: list[str] = []
+
+    def visit(path, x, spec):
+        want = NamedSharding(mesh, spec if spec is not None else P())
+        got = getattr(x, "sharding", None)
+        if got is None:
+            errors.append(f"{_path_str(path)}: not a committed device array")
+        elif not got.is_equivalent_to(want, x.ndim):
+            errors.append(
+                f"{_path_str(path)}: sharding {got} != expected "
+                f"NamedSharding(spec={spec})"
+            )
+        return x
+
+    jax.tree_util.tree_map_with_path(
+        visit, tree, specs,
+        is_leaf=lambda t: isinstance(t, P) or t is None,
+    )
+    if errors:
+        raise AssertionError(
+            "sharding mismatch (silent replication / dropped constraint?):\n  "
+            + "\n  ".join(errors[:20])
+            + (f"\n  ... and {len(errors) - 20} more" if len(errors) > 20 else "")
+        )
+
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def collective_counts(jitted_fn, *args, **kwargs) -> dict[str, int]:
+    """Compile ``jitted_fn(*args)`` and count HLO collectives by kind.
+
+    Works on anything with ``.lower()`` (a ``jax.jit`` result).  ``-start``
+    variants (async collectives) count once, not twice.
+    """
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()]
+    counts = {k: 0 for k in _COLLECTIVES}
+    # HLO line shapes: `%name = f32[4,8]{1,0} all-reduce(%dot), ...` and the
+    # combined/async forms `%ar = (f32[..], f32[..]) all-reduce-start(...)`;
+    # `-done` halves must NOT double-count.  op_name metadata is stripped so
+    # source attributions can't fake a match.
+    pattern = re.compile(
+        r"\s(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+        r"(-start)?\("
+    )
+    for text in texts:
+        for line in text.splitlines():
+            if "=" not in line:
+                continue
+            m = pattern.search(line.split("metadata=")[0])
+            if m:
+                counts[m.group(1)] += 1
+    return counts

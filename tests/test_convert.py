@@ -111,3 +111,40 @@ class TestMixtralConvert:
                     params["layers"]["mlp"]["experts"]["down"][i, j]).T
         back = hf_mixtral_to_native(state, xcfg)
         tree_equal(jax.tree_util.tree_map(np.asarray, params), back)
+
+
+def test_mixtral_native_hf_round_trip():
+    """native -> HF -> native is exact (the nxdt->HF converter direction)."""
+    from neuronx_distributed_training_tpu.models import mixtral
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+    from neuronx_distributed_training_tpu.tools.convert import (
+        hf_mixtral_to_native,
+        native_to_hf_mixtral,
+    )
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                       softmax_dtype=jnp.float32)
+    cfg = mixtral.MixtralConfig(
+        llama=llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        ),
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg, fp32)
+    hf = native_to_hf_mixtral(params, cfg)
+    assert "model.layers.0.block_sparse_moe.experts.3.w2.weight" in hf
+    back = hf_mixtral_to_native(hf, cfg)
+
+    def eq(a, b, path=""):
+        if isinstance(a, dict):
+            assert set(a) == set(b), path
+            for k in a:
+                eq(a[k], b[k], path + "/" + k)
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=path)
+
+    eq(jax.tree_util.tree_map(np.asarray, params), back)
