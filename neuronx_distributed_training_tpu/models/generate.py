@@ -36,6 +36,40 @@ def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
     return jnp.asarray(ids), jnp.asarray(lens)
 
 
+def filter_logits(
+    logits: jax.Array,  # [b, vocab]
+    *,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Top-k / nucleus (top-p) filtering: non-kept tokens -> -inf.
+
+    The reference eval harness exposes the same knobs
+    (``sft_evaluation/evaluate.py:245-266``).  Both filters are threshold
+    computations (no scatter): top-k keeps logits >= the k-th largest; top-p
+    keeps the smallest prefix of the descending-sorted distribution whose
+    cumulative probability reaches ``top_p`` (the first token always kept).
+    """
+    neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        # f32 throughout: a bf16 cumsum over a 32k+ vocab loses tail mass and
+        # misplaces the cutoff (~0.004 resolution near 1.0)
+        sorted_logits = jnp.sort(logits.astype(jnp.float32), axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep while the cumulative mass BEFORE this token is < top_p
+        keep = (cum - probs) < top_p
+        # threshold = smallest kept logit in sorted order
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits.astype(jnp.float32) < thresh, neg, logits)
+    return logits
+
+
 def generate(
     params: Any,
     prompt_ids: jax.Array,  # [b, prompt_len] RIGHT-padded with pad_id
@@ -46,6 +80,8 @@ def generate(
     eos_id: int,
     pad_id: int = 0,
     temperature: float = 0.0,  # 0 = greedy
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate up to ``max_new_tokens``; returns ``[b, prompt_len + max_new]``.
@@ -70,7 +106,12 @@ def generate(
         next_logits = logits[rows, pos - 1, :]
         if temperature > 0:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
+            # temperature FIRST, then the nucleus — top-p must be computed on
+            # the distribution actually sampled (HF/reference semantics)
+            scaled = filter_logits(
+                next_logits / temperature, top_k=top_k, top_p=top_p
+            )
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(next_logits, axis=-1)
         nxt = nxt.astype(buf.dtype)

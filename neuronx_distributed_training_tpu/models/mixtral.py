@@ -79,12 +79,27 @@ class MixtralConfig:
         )
 
 
-def init_params(key: jax.Array, cfg: MixtralConfig, policy: DtypePolicy | None = None):
-    """Llama skeleton with each layer's dense MLP replaced by router+experts."""
-    if cfg.moe_frequency != 1:
-        raise NotImplementedError(
-            "moe_frequency > 1 (dense/MoE interleave) not yet supported"
+def num_moe_layers(cfg: MixtralConfig) -> int:
+    """Layer ``i`` is MoE iff ``i % moe_frequency == 0`` (reference
+    ``modeling_mixtral.py:444-451``)."""
+    f = cfg.moe_frequency
+    if cfg.llama.num_layers % f != 0:
+        raise ValueError(
+            f"num_layers {cfg.llama.num_layers} must divide by moe "
+            f"frequency {f}"
         )
+    return cfg.llama.num_layers // f
+
+
+def init_params(key: jax.Array, cfg: MixtralConfig, policy: DtypePolicy | None = None):
+    """Llama skeleton with MoE MLPs every ``moe_frequency``-th layer.
+
+    ``moe_frequency == 1`` (Mixtral proper): every layer's MLP is
+    router+experts, stacked ``[L, ...]``.  ``> 1``: the stack is grouped as
+    ``[L/f]`` groups of (1 MoE layer + f-1 dense layers); attention/norm
+    params stay flat ``[L, ...]`` and ``layers.mlp`` becomes
+    ``{"moe": [L/f, ...], "dense": [L/f, f-1, ...]}``.
+    """
     policy = policy or DtypePolicy()
     dtype = policy.param_dtype
     lc = cfg.llama
@@ -96,19 +111,42 @@ def init_params(key: jax.Array, cfg: MixtralConfig, policy: DtypePolicy | None =
             dtype=dtype, stddev=lc.initializer_range,
         )
 
-    moe_keys = jax.random.split(jax.random.fold_in(key, 999), lc.num_layers)
-    params["layers"]["mlp"] = jax.vmap(init_layer_moe)(moe_keys)
+    g = num_moe_layers(cfg)
+    moe_keys = jax.random.split(jax.random.fold_in(key, 999), g)
+    moe = jax.vmap(init_layer_moe)(moe_keys)
+    if cfg.moe_frequency == 1:
+        params["layers"]["mlp"] = moe
+    else:
+        f = cfg.moe_frequency
+        dense = jax.tree_util.tree_map(
+            lambda x: x.reshape((g, f) + x.shape[1:])[:, 1:],
+            params["layers"]["mlp"],
+        )
+        params["layers"]["mlp"] = {"moe": moe, "dense": dense}
     return params
 
 
 def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
+    if pipeline and cfg.moe_frequency != 1:
+        raise NotImplementedError(
+            "pipeline parallelism with moe_frequency > 1 not supported yet"
+        )
     specs = llama.param_specs(cfg.llama, pipeline=pipeline)
     lead = "pipe" if pipeline else None
-    moe_specs = moe_ops.moe_param_specs(cfg.moe)
-    specs["layers"]["mlp"] = jax.tree_util.tree_map(
-        lambda s: P(*((lead,) + tuple(s))), moe_specs,
+    moe_specs = jax.tree_util.tree_map(
+        lambda s: P(*((lead,) + tuple(s))), moe_ops.moe_param_specs(cfg.moe),
         is_leaf=lambda x: isinstance(x, P),
     )
+    if cfg.moe_frequency == 1:
+        specs["layers"]["mlp"] = moe_specs
+    else:
+        # dense leaves gain the inner (f-1) group dim after the layer dim
+        dense_specs = jax.tree_util.tree_map(
+            lambda s: P(*((tuple(s)[0], None) + tuple(s)[1:])),
+            specs["layers"]["mlp"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        specs["layers"]["mlp"] = {"moe": moe_specs, "dense": dense_specs}
     return specs
 
 
@@ -141,6 +179,10 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
     through pipeline stages (``modeling_mixtral.py:440-549``).  The caller
     scales the psum'd total by ``1 / (num_microbatches * num_layers)``.
     """
+    if cfg.moe_frequency != 1:
+        raise NotImplementedError(
+            "pipeline parallelism with moe_frequency > 1 not supported yet"
+        )
     lc = cfg.llama
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
 
@@ -208,22 +250,51 @@ def forward(
         input_ids, lc, positions=llama.positions_for(input_ids, attention_mask)
     )
     layer_stack = policy.cast_to_compute(params["layers"])
-
-    def body(carry, lp):
-        x, aux_acc = carry
-        x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy,
-                                attention_mask=attention_mask)
-        return (x, aux_acc + aux), None
-
     remat = llama._remat_policy(lc.activations_checkpoint_granularity)
+
+    if cfg.moe_frequency == 1:
+
+        def body(carry, lp):
+            x, aux_acc = carry
+            x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy,
+                                    attention_mask=attention_mask)
+            return (x, aux_acc + aux), None
+
+        xs = layer_stack
+    else:
+        # grouped interleave: scan over [L/f] groups of (MoE + f-1 dense)
+        f, g = cfg.moe_frequency, num_moe_layers(cfg)
+        shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
+        head = jax.tree_util.tree_map(
+            lambda x: x.reshape((g, f) + x.shape[1:])[:, 0], shared)
+        tail = jax.tree_util.tree_map(
+            lambda x: x.reshape((g, f) + x.shape[1:])[:, 1:], shared)
+        xs = {"moe": {**head, "mlp": layer_stack["mlp"]["moe"]},
+              "dense": {**tail, "mlp": layer_stack["mlp"]["dense"]}}
+
+        def body(carry, gp):
+            x, aux_acc = carry
+            x, aux = _decoder_layer(gp["moe"], x, cos, sin, cfg, policy,
+                                    attention_mask=attention_mask)
+
+            def dense_body(x2, dlp):
+                return llama._decoder_layer(
+                    dlp, x2, cos, sin, lc, policy,
+                    attention_mask=attention_mask,
+                ), None
+
+            x, _ = jax.lax.scan(dense_body, x, gp["dense"])
+            return (x, aux_acc + aux), None
+
     if remat is not None:
         body = jax.checkpoint(body, policy=remat, prevent_cse=False)
-    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layer_stack)
+    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
     hidden = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
     logits = llama.logits_fn(params, hidden, lc, policy)
 
-    # router_aux_loss is already coefficient-weighted (weighted_router_loss)
-    aux: dict[str, Any] = {"router_aux_loss": aux_sum / lc.num_layers}
+    # router_aux_loss is already coefficient-weighted (weighted_router_loss);
+    # averaged over the layers that HAVE routers
+    aux: dict[str, Any] = {"router_aux_loss": aux_sum / num_moe_layers(cfg)}
     if return_logits:
         aux["logits"] = logits
     labels = batch.get("labels")

@@ -120,6 +120,11 @@ def native_to_hf_llama(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
 def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     """HF Mixtral state_dict -> native pytree (fused expert stacking,
     the reference's ``hf_nxdt_mixtral_ckpt_converter.py:40-60`` role)."""
+    if getattr(cfg, "moe_frequency", 1) != 1:
+        raise NotImplementedError(
+            "checkpoint conversion for moe_frequency > 1 (interleaved "
+            "dense/MoE layout) not supported yet"
+        )
     lc, e = cfg.llama, cfg.moe.num_experts
     g = lambda name: np.asarray(state[name])
     layers = []
@@ -162,6 +167,11 @@ def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray
     """Native Mixtral pytree -> HF state_dict (inverse of
     ``hf_mixtral_to_native``; the reference's nxdt->HF direction,
     ``hf_nxdt_mixtral_ckpt_converter.py:62-91``)."""
+    if getattr(cfg, "moe_frequency", 1) != 1:
+        raise NotImplementedError(
+            "checkpoint conversion for moe_frequency > 1 (interleaved "
+            "dense/MoE layout) not supported yet"
+        )
     lc, e = cfg.llama, cfg.moe.num_experts
     nh, nkv, d = lc.num_attention_heads, lc.kv_heads, lc.head_size
     f = lc.intermediate_size

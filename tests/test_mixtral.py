@@ -123,3 +123,82 @@ def test_mixtral_left_padded_matches_unpadded():
         params, {"input_ids": padded, "attention_mask": mask}, CFG, FP32)
     np.testing.assert_allclose(
         np.asarray(out[:, pad:]), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+class TestMoEFrequency:
+    """Dense/MoE interleave (reference modeling_mixtral.py:444-451:
+    layer i is MoE iff i % frequency == 0)."""
+
+    def _cfg(self, freq):
+        import dataclasses
+
+        return mixtral.MixtralConfig(
+            llama=dataclasses.replace(CFG.llama, num_layers=4),
+            moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True,
+                                  router_aux_loss_coef=0.02),
+            moe_frequency=freq,
+        )
+
+    def test_interleaved_equals_dense_when_experts_identical(self):
+        """With every expert a copy of the dense MLP weights, top-k renorm
+        makes MoE(x) == MLP(x): the freq-2 model must match pure llama."""
+        cfg = self._cfg(2)
+        lc = cfg.llama
+        lparams = llama.init_params(jax.random.PRNGKey(0), lc, FP32)
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        # attention/norm trees are identical by construction (same init);
+        # make dense sub-layers equal llama's layers 1,3 and experts copies
+        # of llama's layers 0,2 MLPs
+        g, f, e = 2, 2, 4
+        dense_src = jax.tree_util.tree_map(
+            lambda x: x.reshape((g, f) + x.shape[1:])[:, 1:], lparams["layers"]["mlp"])
+        params["layers"]["mlp"]["dense"] = dense_src
+        moe_src_gate_up = np.asarray(lparams["layers"]["mlp"]["gate_up"]["w"]).reshape(
+            (g, f) + lparams["layers"]["mlp"]["gate_up"]["w"].shape[1:])[:, 0]
+        moe_src_down = np.asarray(lparams["layers"]["mlp"]["down"]["w"]).reshape(
+            (g, f) + lparams["layers"]["mlp"]["down"]["w"].shape[1:])[:, 0]
+        params["layers"]["mlp"]["moe"]["experts"]["gate_up"] = jnp.asarray(
+            np.repeat(moe_src_gate_up[:, None], e, axis=1))
+        params["layers"]["mlp"]["moe"]["experts"]["down"] = jnp.asarray(
+            np.repeat(moe_src_down[:, None], e, axis=1))
+
+        batch = _batch(jax.random.PRNGKey(1))
+        ref_logits, _ = llama.forward(lparams, {"input_ids": batch["input_ids"]},
+                                      lc, FP32)
+        logits, aux = mixtral.forward(params, {"input_ids": batch["input_ids"]},
+                                      cfg, FP32)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_interleaved_trains(self):
+        cfg = self._cfg(2)
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        batch = _batch(jax.random.PRNGKey(1))
+
+        def loss_fn(p):
+            return mixtral.forward(p, batch, cfg, FP32)[0]
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        # grads reach the router, experts, AND the dense sub-layers
+        assert float(np.abs(np.asarray(
+            grads["layers"]["mlp"]["moe"]["router"]["w"])).max()) > 0
+        assert float(np.abs(np.asarray(
+            grads["layers"]["mlp"]["dense"]["gate_up"]["w"])).max()) > 0
+
+    def test_specs_match_param_tree(self):
+        cfg = self._cfg(2)
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        specs = mixtral.param_specs(cfg)
+        flat_p = jax.tree_util.tree_structure(params)
+        flat_s = jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        assert flat_p == flat_s
+
+    def test_indivisible_raises(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(self._cfg(2),
+                                  llama=dataclasses.replace(CFG.llama, num_layers=3))
+        with pytest.raises(ValueError, match="frequency"):
+            mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)

@@ -171,3 +171,49 @@ class TestEvalMetrics:
         f.write_text('{"input": "a", "output": "b"}\n{"input": "c", "output": "d"}\n')
         recs = load_alignment_records(f)
         assert len(recs) == 2 and recs[1]["output"] == "d"
+
+
+class TestSamplingFilters:
+    """top-k / nucleus filtering (reference evaluate.py:245-266 knobs)."""
+
+    def test_top_k_keeps_k(self):
+        from neuronx_distributed_training_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+        out = filter_logits(logits, top_k=2)
+        kept = np.isfinite(np.asarray(out)) & (np.asarray(out) > -1e30)
+        np.testing.assert_array_equal(kept[0], [False, True, False, False, True])
+
+    def test_top_p_keeps_nucleus(self):
+        from neuronx_distributed_training_tpu.models.generate import filter_logits
+
+        # softmax probs ~ [0.64, 0.24, 0.09, 0.03]; top_p=0.7 keeps first two
+        logits = jnp.log(jnp.asarray([[0.64, 0.24, 0.09, 0.03]]))
+        out = np.asarray(filter_logits(logits, top_p=0.7))
+        kept = out > -1e30
+        np.testing.assert_array_equal(kept[0], [True, True, False, False])
+
+    def test_first_token_always_kept(self):
+        from neuronx_distributed_training_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[10.0, 0.0, 0.0]])  # prob ~1 on token 0
+        out = np.asarray(filter_logits(logits, top_p=0.1))
+        assert out[0, 0] > -1e30 and (out[0, 1:] < -1e30).all()
+
+    def test_sampled_generation_respects_top_k(self):
+        from neuronx_distributed_training_tpu.models.generate import generate
+
+        vocab = 16
+
+        def logits_of(params, ids):
+            # constant distribution strongly favoring tokens 3 and 5
+            base = jnp.full((vocab,), -10.0).at[3].set(5.0).at[5].set(4.0)
+            return jnp.broadcast_to(base, ids.shape + (vocab,))
+
+        ids = jnp.zeros((2, 4), jnp.int32)
+        lens = jnp.asarray([4, 4], jnp.int32)
+        out = generate(None, ids, lens, logits_of, max_new_tokens=8,
+                       eos_id=15, temperature=1.0, top_k=2,
+                       key=jax.random.PRNGKey(0))
+        gen = np.asarray(out[:, 4:])
+        assert set(np.unique(gen)) <= {3, 5}
