@@ -1,0 +1,210 @@
+"""KV-cache autoregressive decoding for the Llama family.
+
+The reference's SFT-evaluation inference path is a traced decoder with KV
+caching (``sft_evaluation/models/nxd_llama.py`` LlamaRunner); the plain
+``models.generate`` here re-runs the full prefix per token — fine for tiny
+evals, O(n^2 · L) wrong for real generation.  This module is the cached
+path:
+
+- ``prefill``: one causal forward over the right-padded prompts that also
+  captures each layer's rotated K and V into the cache;
+- ``decode_step``: a single-token forward attending over ``cache[: pos+1]``
+  per row (static ``max_len`` buffer + position mask — XLA-friendly, no
+  dynamic shapes);
+- ``generate_cached``: drop-in for ``generate`` (same right-padded /
+  front-writing convention, so generated tokens land exactly on the cache
+  slots the row's prompt padding occupied, and the position mask keeps stale
+  pad entries invisible).
+
+Parity with the uncached path is test-enforced (greedy outputs must match
+``models.generate`` exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.models import llama
+from neuronx_distributed_training_tpu.ops import linear as linear_ops
+from neuronx_distributed_training_tpu.ops import norm as norm_ops
+from neuronx_distributed_training_tpu.ops import rope as rope_ops
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+
+def _qkv(lp, x, cfg: llama.LlamaConfig):
+    b, s, _ = x.shape
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    if cfg.fuse_qkv:
+        qkv = linear_ops.apply_linear(lp["qkv"], x)
+        q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
+    else:
+        q = linear_ops.apply_linear(lp["q"], x)
+        k = linear_ops.apply_linear(lp["k"], x)
+        v = linear_ops.apply_linear(lp["v"], x)
+    return (q.reshape(b, s, nh, d), k.reshape(b, s, nkv, d),
+            v.reshape(b, s, nkv, d))
+
+
+def prefill(params, input_ids: jax.Array, cfg: llama.LlamaConfig,
+            policy: DtypePolicy, *, max_len: Optional[int] = None):
+    """Causal forward capturing the KV cache.
+
+    Returns ``(logits [b, s, vocab], cache {"k","v"}: [L, b, max_len, kvh, d])``
+    with rotated keys; cache tail beyond ``s`` is zeros (masked out by
+    position during decode).
+    """
+    b, s = input_ids.shape
+    max_len = max_len or s
+    x = linear_ops.apply_embedding(
+        params["embed"], input_ids, compute_dtype=policy.compute_dtype
+    )
+    cos, sin = llama._rope_for(input_ids, cfg)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(x, lp):
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=cfg.rms_norm_eps)
+        q, k, v = _qkv(lp["attn"], hidden, cfg)
+        q = rope_ops.apply_rope(q, cos, sin)
+        k = rope_ops.apply_rope(k, cos, sin)
+        from neuronx_distributed_training_tpu.ops import attention as attn_ops
+
+        out = attn_ops.attention(
+            q, k, v, impl=cfg.attention_impl, causal=True,
+            sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
+        )
+        out = out.reshape(b, s, -1)
+        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out)
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=cfg.rms_norm_eps)
+        x = residual + llama._mlp_block(lp["mlp"], hidden)
+        # pad the cached block out to max_len (static)
+        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
+    h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=cfg.rms_norm_eps)
+    logits = llama.logits_fn(params, h, cfg, policy)
+    return logits, {"k": ck, "v": cv}
+
+
+def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
+                cfg: llama.LlamaConfig, policy: DtypePolicy):
+    """One token per row: write KV at ``pos[b]``, attend over ``<= pos[b]``.
+
+    ``tokens [b]`` int32, ``pos [b]`` the buffer position being filled.
+    Returns ``(logits [b, vocab], new_cache)``.
+    """
+    b = tokens.shape[0]
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    max_len = cache["k"].shape[2]
+    rows = jnp.arange(b)
+    x = linear_ops.apply_embedding(
+        params["embed"], tokens[:, None], compute_dtype=policy.compute_dtype
+    )
+    inv_freq = rope_ops.rope_frequencies(
+        cfg.head_size, theta=cfg.rope_theta,
+        position_interpolation_factor=cfg.rope_interpolation_factor,
+    )
+    cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
+    layer_stack = policy.cast_to_compute(params["layers"])
+    valid = (jnp.arange(max_len)[None, :] <= pos[:, None])  # [b, max_len]
+    neg = jnp.asarray(jnp.finfo(policy.softmax_dtype).min / 2, policy.softmax_dtype)
+
+    def body(x, inp):
+        lp, ck, cv = inp  # ck/cv [b, max_len, nkv, d]
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=cfg.rms_norm_eps)
+        q, k, v = _qkv(lp["attn"], hidden, cfg)  # [b, 1, ., d]
+        q = rope_ops.apply_rope(q, cos, sin)
+        k = rope_ops.apply_rope(k, cos, sin)
+        ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+        kk = jnp.repeat(ck, nh // nkv, axis=2) if nkv != nh else ck
+        vv = jnp.repeat(cv, nh // nkv, axis=2) if nkv != nh else cv
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kk, preferred_element_type=policy.softmax_dtype
+        ) * (1.0 / (d ** 0.5))
+        if cfg.sliding_window is not None:
+            win_ok = (jnp.arange(max_len)[None, :]
+                      > pos[:, None] - cfg.sliding_window)
+            mask = valid & win_ok
+        else:
+            mask = valid
+        scores = jnp.where(mask[:, None, None, :], scores, neg)
+        probs = jax.nn.softmax(scores.astype(policy.softmax_dtype), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vv.dtype), vv)
+        out = out.reshape(b, 1, nh * d).astype(x.dtype)
+        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out)
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=cfg.rms_norm_eps)
+        x = residual + llama._mlp_block(lp["mlp"], hidden)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
+    h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=cfg.rms_norm_eps)
+    logits = llama.logits_fn(params, h, cfg, policy)
+    return logits[:, 0], {"k": ck, "v": cv}
+
+
+def generate_cached(
+    params: Any,
+    cfg: llama.LlamaConfig,
+    policy: DtypePolicy,
+    prompt_ids: jax.Array,   # [b, plen] RIGHT-padded
+    prompt_lens: jax.Array,  # [b]
+    *,
+    max_new_tokens: int,
+    eos_id: int,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """KV-cached counterpart of ``models.generate.generate`` (same contract)."""
+    from neuronx_distributed_training_tpu.models.generate import filter_logits
+
+    b, plen = prompt_ids.shape
+    total = plen + max_new_tokens
+    lens = prompt_lens.astype(jnp.int32)
+    rows = jnp.arange(b)
+
+    logits, cache = prefill(params, prompt_ids, cfg, policy, max_len=total)
+    buf = jnp.full((b, total), pad_id, dtype=prompt_ids.dtype)
+    buf = buf.at[:, :plen].set(prompt_ids)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def pick(next_logits, key):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            scaled = filter_logits(
+                next_logits / temperature, top_k=top_k, top_p=top_p
+            )
+            return jax.random.categorical(sub, scaled, axis=-1), key
+        return jnp.argmax(next_logits, axis=-1), key
+
+    # token 0 comes from the prefill logits at each row's last prompt position
+    first, key = pick(logits[rows, lens - 1], key)
+    first = first.astype(buf.dtype)
+    buf = buf.at[rows, lens].set(first)  # the EOS itself stays visible
+    done0 = first == eos_id
+
+    def step(i, carry):
+        buf, cache, done, key = carry
+        pos = lens + i  # position holding the PREVIOUS token
+        prev = buf[rows, pos]
+        logits, cache = decode_step(params, cache, prev, pos, cfg, policy)
+        nxt, key = pick(logits, key)
+        nxt = jnp.where(done, jnp.asarray(pad_id, buf.dtype), nxt.astype(buf.dtype))
+        buf = buf.at[rows, pos + 1].set(nxt)
+        done = done | (nxt == eos_id)
+        return buf, cache, done, key
+
+    buf, _, _, _ = jax.lax.fori_loop(
+        0, max_new_tokens - 1, step, (buf, cache, done0, key)
+    )
+    return buf

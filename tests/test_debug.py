@@ -81,3 +81,58 @@ class TestCollectiveCensus:
 
         counts = collective_counts(f, x, w)
         assert all(v == 0 for v in counts.values()), counts
+
+
+class TestTrainStepCollectives:
+    def test_tp_zero1_train_step_pattern(self, tp_mesh):
+        """The compiled TP=2 + ZeRO-1 train step must contain reduction
+        collectives (grad sync) and gather collectives (ZeRO-1 param
+        all-gather) — zeros would mean the mesh sharding silently degraded
+        to replication."""
+        import jax.numpy as jnp
+
+        from neuronx_distributed_training_tpu.models import llama
+        from neuronx_distributed_training_tpu.optim.adamw import (
+            AdamWConfig,
+            init_opt_state,
+            opt_state_specs,
+        )
+        from neuronx_distributed_training_tpu.parallel import sharding as shd
+        from neuronx_distributed_training_tpu.trainer.step import (
+            jit_train_step,
+            make_train_step,
+        )
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                             softmax_dtype=jnp.float32)
+        cfg = llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None, sequence_parallel=True,
+        )
+        with tp_mesh, shd.use_mesh(tp_mesh):
+            params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
+            pspecs = llama.param_specs(cfg)
+            ns = lambda spec: NamedSharding(tp_mesh, spec)
+            params = jax.device_put(params, jax.tree_util.tree_map(
+                ns, pspecs, is_leaf=lambda x: isinstance(x, P)))
+            opt = init_opt_state(params, policy)
+            ospecs = opt_state_specs(params, pspecs, tp_mesh, zero1=True,
+                                     policy=policy)
+            opt = jax.device_put(opt, jax.tree_util.tree_map(
+                ns, ospecs, is_leaf=lambda x: isinstance(x, P)))
+
+            def loss_fn(p, batch, key):
+                return llama.forward(p, batch, cfg, policy)
+
+            step = make_train_step(loss_fn, AdamWConfig(), lambda s: 1e-3, policy)
+            jstep = jit_train_step(step, tp_mesh, pspecs, ospecs)
+            ids = jnp.zeros((8, 16), jnp.int32)
+            batch = {"input_ids": ids, "labels": ids}
+            counts = collective_counts(
+                jstep, params, opt, batch, jax.random.PRNGKey(0))
+        reductions = counts["all-reduce"] + counts["reduce-scatter"]
+        gathers = counts["all-gather"]
+        assert reductions >= 1, counts   # TP grad/activation reductions
+        assert gathers >= 1, counts      # ZeRO-1 sharded-update re-gather
