@@ -31,6 +31,7 @@ from neuronx_distributed_training_tpu.models import llama
 from neuronx_distributed_training_tpu.ops import linear as linear_ops
 from neuronx_distributed_training_tpu.ops import norm as norm_ops
 from neuronx_distributed_training_tpu.ops import rope as rope_ops
+from neuronx_distributed_training_tpu.parallel import sharding as shd
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
 
@@ -52,43 +53,35 @@ def prefill(params, input_ids: jax.Array, cfg: llama.LlamaConfig,
             policy: DtypePolicy, *, max_len: Optional[int] = None):
     """Causal forward capturing the KV cache.
 
-    Returns ``(logits [b, s, vocab], cache {"k","v"}: [L, b, max_len, kvh, d])``
+    Returns ``(hidden [b, s, h], cache {"k","v"}: [L, b, max_len, kvh, d])``
     with rotated keys; cache tail beyond ``s`` is zeros (masked out by
-    position during decode).
+    position during decode).  Callers take logits where they need them
+    (``llama.logits_fn``) — generation only reads ONE position per row, and a
+    full [b, s, vocab] logits tensor is the dominant prefill allocation.
+
+    The layer math is ``llama._decoder_layer(return_kv=True)`` — shared code,
+    shared sharding constraints, so TP/SP prefill shards like training.
     """
-    b, s = input_ids.shape
+    s = input_ids.shape[1]
     max_len = max_len or s
+    aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
     x = linear_ops.apply_embedding(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
+    x = shd.constrain(x, aspec)
     cos, sin = llama._rope_for(input_ids, cfg)
     layer_stack = policy.cast_to_compute(params["layers"])
 
     def body(x, lp):
-        residual = x
-        hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=cfg.rms_norm_eps)
-        q, k, v = _qkv(lp["attn"], hidden, cfg)
-        q = rope_ops.apply_rope(q, cos, sin)
-        k = rope_ops.apply_rope(k, cos, sin)
-        from neuronx_distributed_training_tpu.ops import attention as attn_ops
-
-        out = attn_ops.attention(
-            q, k, v, impl=cfg.attention_impl, causal=True,
-            sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
-        )
-        out = out.reshape(b, s, -1)
-        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out)
-        residual = x
-        hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=cfg.rms_norm_eps)
-        x = residual + llama._mlp_block(lp["mlp"], hidden)
+        x, (k, v) = llama._decoder_layer(lp, x, cos, sin, cfg, policy,
+                                         return_kv=True)
         # pad the cached block out to max_len (static)
         pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
     x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
     h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=cfg.rms_norm_eps)
-    logits = llama.logits_fn(params, h, cfg, policy)
-    return logits, {"k": ck, "v": cv}
+    return h, {"k": ck, "v": cv}
 
 
 def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
@@ -173,9 +166,13 @@ def generate_cached(
     lens = prompt_lens.astype(jnp.int32)
     rows = jnp.arange(b)
 
-    logits, cache = prefill(params, prompt_ids, cfg, policy, max_len=total)
     buf = jnp.full((b, total), pad_id, dtype=prompt_ids.dtype)
     buf = buf.at[:, :plen].set(prompt_ids)
+    if max_new_tokens <= 0:  # same no-op contract as generate()
+        return buf
+    h, cache = prefill(params, prompt_ids, cfg, policy, max_len=total)
+    # logits ONLY at each row's last prompt position ([b, 1, h] -> [b, vocab])
+    logits = llama.logits_fn(params, h[rows, lens - 1][:, None], cfg, policy)[:, 0]
     key = key if key is not None else jax.random.PRNGKey(0)
 
     def pick(next_logits, key):
@@ -188,7 +185,7 @@ def generate_cached(
         return jnp.argmax(next_logits, axis=-1), key
 
     # token 0 comes from the prefill logits at each row's last prompt position
-    first, key = pick(logits[rows, lens - 1], key)
+    first, key = pick(logits, key)
     first = first.astype(buf.dtype)
     buf = buf.at[rows, lens].set(first)  # the EOS itself stays visible
     done0 = first == eos_id

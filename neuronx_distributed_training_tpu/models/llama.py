@@ -234,7 +234,7 @@ def param_specs(cfg: LlamaConfig, *, pipeline: bool = False):
 
 
 def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
-                     attention_mask=None):
+                     attention_mask=None, return_kv=False):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     if cfg.fuse_qkv:
@@ -261,7 +261,10 @@ def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
     out = out.reshape(b, s, nh * d)
     # RowParallel o_proj; reduce(-scatter under SP) inserted by GSPMD
     # (reference modeling_llama.py:475)
-    return linear_ops.apply_linear(lp["o"], out)
+    out = linear_ops.apply_linear(lp["o"], out)
+    if return_kv:
+        return out, (k, v)  # rotated keys — the KV-cache contract
+    return out
 
 
 def _mlp_block(lp, x):
@@ -271,17 +274,23 @@ def _mlp_block(lp, x):
 
 
 def _decoder_layer(layer_params, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
-                   attention_mask=None):
+                   attention_mask=None, return_kv=False):
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
     residual = x
     hidden = norm_ops.apply_rms_norm(layer_params["input_norm"], x, eps=cfg.rms_norm_eps)
     hidden = _attention_block(layer_params["attn"], hidden, cos, sin, cfg, policy,
-                              attention_mask=attention_mask)
+                              attention_mask=attention_mask, return_kv=return_kv)
+    kv = None
+    if return_kv:
+        hidden, kv = hidden
     x = shd.constrain(residual + hidden, aspec)
     residual = x
     hidden = norm_ops.apply_rms_norm(layer_params["post_attn_norm"], x, eps=cfg.rms_norm_eps)
     hidden = _mlp_block(layer_params["mlp"], hidden)
-    return shd.constrain(residual + hidden, aspec)
+    x = shd.constrain(residual + hidden, aspec)
+    if return_kv:
+        return x, kv
+    return x
 
 
 def _remat_policy(granularity: Optional[str]):

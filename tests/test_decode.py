@@ -27,15 +27,24 @@ class TestCachedDecode:
     def test_prefill_logits_match_forward(self, params):
         ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 3, 97)
         ref, _ = llama.forward(params, {"input_ids": ids}, CFG, FP32)
-        logits, cache = decode.prefill(params, ids, CFG, FP32, max_len=20)
+        h, cache = decode.prefill(params, ids, CFG, FP32, max_len=20)
+        logits = llama.logits_fn(params, h, CFG, FP32)
         np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
         assert cache["k"].shape == (2, 2, 20, 2, 8)
 
+    def test_zero_new_tokens_is_noop(self, params):
+        prompts = [[5, 6, 7], [10, 11]]
+        from neuronx_distributed_training_tpu.models.generate import pad_prompts as pp
+        ids, lens = pp(prompts, pad_id=0)
+        out = decode.generate_cached(params, CFG, FP32, ids, lens,
+                                     max_new_tokens=0, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
     def test_decode_step_matches_full_forward(self, params):
         """Token t+1 logits from the cache must equal a fresh full forward."""
         ids = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 3, 97)
-        _, cache = decode.prefill(params, ids, CFG, FP32, max_len=16)
+        _h, cache = decode.prefill(params, ids, CFG, FP32, max_len=16)
         nxt = jnp.asarray([11, 23], jnp.int32)
         pos = jnp.asarray([8, 8], jnp.int32)
         step_logits, _ = decode.decode_step(params, cache, nxt, pos, CFG, FP32)
