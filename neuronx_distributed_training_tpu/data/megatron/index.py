@@ -35,10 +35,15 @@ def _load_native() -> Optional[ctypes.CDLL]:
     _lib_tried = True
     try:
         if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
+            # temp + atomic rename: racing workers must not corrupt the .so
+            import os
+
+            tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_LIB_PATH)],
+                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
                 check=True, capture_output=True,
             )
+            os.replace(tmp, _LIB_PATH)
         lib = ctypes.CDLL(str(_LIB_PATH))
         lib.build_sample_idx.restype = ctypes.c_int64
         lib.build_sample_idx.argtypes = [

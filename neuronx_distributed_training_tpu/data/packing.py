@@ -10,11 +10,103 @@ chosen/rejected/prompt keys with left-padded prompts, reference
 
 from __future__ import annotations
 
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
 from typing import Optional, Sequence
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 IGNORE_INDEX = -100  # loss-masked label value, HF convention used by the reference
+
+_SRC = Path(__file__).with_name("packing_native.cpp")
+_LIB_PATH = _SRC.with_suffix(".so")
+_lib = None
+_lib_tried = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the C++ packer; None if no toolchain."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
+            # compile to a per-process temp path + atomic rename: concurrent
+            # dataloader workers racing g++ on one output file can leave a
+            # corrupt .so whose fresh mtime would pin the fallback forever
+            import os
+
+            tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, _LIB_PATH)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pack_count.restype = ctypes.c_int64
+        lib.pack_count.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64]
+        lib.pack_fill.restype = ctypes.c_int64
+        lib.pack_fill.argtypes = [
+            i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+        ]
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 — numpy fallback is always correct
+        logger.debug("native packer unavailable (%s); using the python path", e)
+        _lib = None
+    return _lib
+
+
+def _pack_sequences_native(token_lists, chunk_size, eos_id, label_lists, pad_id):
+    lib = _load_native()
+    if lib is None:
+        return None
+    from itertools import chain
+
+    lens = np.asarray([len(t) for t in token_lists], np.int32)
+    offsets = np.zeros(len(token_lists) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    if label_lists is not None:
+        # length check BEFORE flattening: fromiter(count=N) silently
+        # truncates an over-long iterator, which would shift every
+        # subsequent record's labels
+        if len(label_lists) != len(token_lists) or any(
+            len(l) != len(t) for l, t in zip(label_lists, token_lists)
+        ):
+            return None  # ragged label mismatch; the python path reports clearly
+    flat_ids = np.fromiter(
+        chain.from_iterable(token_lists), np.int32, count=total)
+    if label_lists is not None:
+        flat_lbl = np.fromiter(
+            chain.from_iterable(label_lists), np.int32, count=total)
+    else:
+        flat_lbl = flat_ids
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    n_chunks = lib.pack_count(
+        lens.ctypes.data_as(i32p), len(lens), chunk_size)
+    ids = np.empty((max(int(n_chunks), 0), chunk_size), np.int32)
+    lbl = np.empty_like(ids)
+    if n_chunks:
+        flat_ids = np.ascontiguousarray(flat_ids)
+        flat_lbl = np.ascontiguousarray(flat_lbl)
+        written = lib.pack_fill(
+            flat_ids.ctypes.data_as(i32p), flat_lbl.ctypes.data_as(i32p),
+            offsets.ctypes.data_as(i64p), len(lens), chunk_size,
+            eos_id, pad_id, IGNORE_INDEX,
+            ids.ctypes.data_as(i32p), lbl.ctypes.data_as(i32p),
+        )
+        assert written == n_chunks, (written, n_chunks)
+    loss_mask = (lbl != IGNORE_INDEX).astype(np.float32)
+    return {"input_ids": ids, "labels": lbl, "loss_mask": loss_mask}
 
 
 def pack_sequences(
@@ -33,7 +125,16 @@ def pack_sequences(
     Returns ``input_ids`` ``labels`` ``loss_mask`` arrays ``[n_chunks, chunk_size]``.
     ``labels`` carry ``IGNORE_INDEX`` over padding; per-record labels may be
     supplied (SFT prompt masking), defaulting to the input tokens.
+
+    The hot loop runs in C++ when the toolchain is available (the same
+    compile-on-demand ctypes pattern as ``data/megatron/index.py``; the
+    reference keeps its dataset loops native too) with a bit-identical numpy
+    fallback.
     """
+    native = _pack_sequences_native(
+        token_lists, chunk_size, eos_id, label_lists, pad_id)
+    if native is not None:
+        return native
     chunks_ids: list[np.ndarray] = []
     chunks_lbl: list[np.ndarray] = []
 

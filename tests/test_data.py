@@ -149,3 +149,54 @@ class TestDataModules:
         import jax
 
         assert isinstance(b["input_ids"], jax.Array)
+
+
+class TestNativePacker:
+    """C++ packer must be bit-identical to the numpy path."""
+
+    def _python_pack(self, toks, chunk, eos, lbls=None):
+        import unittest.mock as mock
+
+        from neuronx_distributed_training_tpu.data import packing
+
+        with mock.patch.object(packing, "_pack_sequences_native",
+                               lambda *a: None):
+            return packing.pack_sequences(toks, chunk, eos, label_lists=lbls)
+
+    def test_parity_with_python(self):
+        from neuronx_distributed_training_tpu.data import packing
+
+        if packing._load_native() is None:
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(0)
+        toks = [list(rng.integers(3, 100, rng.integers(1, 40)))
+                for _ in range(200)]
+        toks.append(list(range(3, 3 + 50)))  # an overflow record (dropped)
+        lbls = [[t if i % 3 else -100 for i, t in enumerate(ts)] for ts in toks]
+        got = packing.pack_sequences(toks, 32, eos_id=2, label_lists=lbls)
+        ref = self._python_pack(toks, 32, 2, lbls)
+        for k in ("input_ids", "labels", "loss_mask"):
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+    def test_parity_default_labels_and_empty(self):
+        from neuronx_distributed_training_tpu.data import packing
+
+        if packing._load_native() is None:
+            pytest.skip("no native toolchain")
+        toks = [[5, 6, 7], [8, 9], [10, 11, 12, 13]]
+        got = packing.pack_sequences(toks, 8, eos_id=2)
+        ref = self._python_pack(toks, 8, 2)
+        for k in ("input_ids", "labels", "loss_mask"):
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+        # all-overflow -> zero chunks, correct shapes
+        got0 = packing.pack_sequences([[1] * 50], 8, eos_id=2)
+        assert got0["input_ids"].shape == (0, 8)
+
+    def test_ragged_labels_fall_back_loudly(self):
+        """Over-long per-record labels must NOT silently shift (fromiter
+        truncation); native falls back and python raises/misaligns visibly."""
+        from neuronx_distributed_training_tpu.data import packing
+
+        res = packing._pack_sequences_native(
+            [[1, 2, 3], [4, 5]], 8, 2, [[1, 2, 3, 99], [4, 5]], 0)
+        assert res is None  # native refuses; caller takes the python path
