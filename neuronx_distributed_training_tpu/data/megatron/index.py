@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import subprocess
 from pathlib import Path
 from typing import Optional
 
@@ -22,7 +21,6 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).with_name("index_builder.cpp")
-_LIB_PATH = Path(__file__).with_name("_index_builder.so")
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
@@ -34,17 +32,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
         return _lib
     _lib_tried = True
     try:
-        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
-            # temp + atomic rename: racing workers must not corrupt the .so
-            import os
+        from neuronx_distributed_training_tpu.data._native import compile_and_load
 
-            tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
-                check=True, capture_output=True,
-            )
-            os.replace(tmp, _LIB_PATH)
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = compile_and_load(_SRC)
+        if lib is None:
+            raise OSError("native index builder unavailable")
         lib.build_sample_idx.restype = ctypes.c_int64
         lib.build_sample_idx.argtypes = [
             ctypes.POINTER(ctypes.c_int32),

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import subprocess
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -23,7 +22,6 @@ logger = logging.getLogger(__name__)
 IGNORE_INDEX = -100  # loss-masked label value, HF convention used by the reference
 
 _SRC = Path(__file__).with_name("packing_native.cpp")
-_LIB_PATH = _SRC.with_suffix(".so")
 _lib = None
 _lib_tried = False
 
@@ -34,20 +32,10 @@ def _load_native() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    try:
-        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
-            # compile to a per-process temp path + atomic rename: concurrent
-            # dataloader workers racing g++ on one output file can leave a
-            # corrupt .so whose fresh mtime would pin the fallback forever
-            import os
+    from neuronx_distributed_training_tpu.data._native import compile_and_load
 
-            tmp = _LIB_PATH.with_suffix(f".{os.getpid()}.tmp.so")
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
-                check=True, capture_output=True,
-            )
-            os.replace(tmp, _LIB_PATH)
-        lib = ctypes.CDLL(str(_LIB_PATH))
+    lib = compile_and_load(_SRC)
+    if lib is not None:
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
         lib.pack_count.restype = ctypes.c_int64
@@ -57,10 +45,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
             i32p, i32p, i64p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, i32p, i32p,
         ]
-        _lib = lib
-    except Exception as e:  # noqa: BLE001 — numpy fallback is always correct
-        logger.debug("native packer unavailable (%s); using the python path", e)
-        _lib = None
+    _lib = lib
     return _lib
 
 

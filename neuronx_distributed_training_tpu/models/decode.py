@@ -1,4 +1,4 @@
-"""KV-cache autoregressive decoding for the Llama family.
+"""KV-cache autoregressive decoding (Llama / Mixtral / Megatron-GPT).
 
 The reference's SFT-evaluation inference path is a traced decoder with KV
 caching (``sft_evaluation/models/nxd_llama.py`` LlamaRunner); the plain
@@ -84,6 +84,35 @@ def prefill(params, input_ids: jax.Array, cfg: llama.LlamaConfig,
     return h, {"k": ck, "v": cv}
 
 
+def _cached_attn(q, k_new, v_new, ck, cv, pos, *, sliding_window,
+                 softmax_dtype):
+    """Write this step's KV at ``pos`` per row, attend q over ``<= pos``.
+
+    q/k_new/v_new [b, 1, heads, d]; ck/cv [b, max_len, kvh, d].
+    Returns (out [b, 1, nh*d], ck, cv).
+    """
+    b, _, nh, d = q.shape
+    nkv = ck.shape[2]
+    max_len = ck.shape[1]
+    rows = jnp.arange(b)
+    ck = ck.at[rows, pos].set(k_new[:, 0].astype(ck.dtype))
+    cv = cv.at[rows, pos].set(v_new[:, 0].astype(cv.dtype))
+    kk = jnp.repeat(ck, nh // nkv, axis=2) if nkv != nh else ck
+    vv = jnp.repeat(cv, nh // nkv, axis=2) if nkv != nh else cv
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kk, preferred_element_type=softmax_dtype
+    ) * (1.0 / (d ** 0.5))
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]
+    if sliding_window is not None:
+        valid = valid & (jnp.arange(max_len)[None, :]
+                         > pos[:, None] - sliding_window)
+    neg = jnp.asarray(jnp.finfo(softmax_dtype).min / 2, softmax_dtype)
+    scores = jnp.where(valid[:, None, None, :], scores, neg)
+    probs = jax.nn.softmax(scores.astype(softmax_dtype), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vv.dtype), vv)
+    return out.reshape(b, 1, nh * d).astype(q.dtype), ck, cv
+
+
 def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
                 cfg: llama.LlamaConfig, policy: DtypePolicy):
     """One token per row: write KV at ``pos[b]``, attend over ``<= pos[b]``.
@@ -91,10 +120,6 @@ def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
     ``tokens [b]`` int32, ``pos [b]`` the buffer position being filled.
     Returns ``(logits [b, vocab], new_cache)``.
     """
-    b = tokens.shape[0]
-    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
-    max_len = cache["k"].shape[2]
-    rows = jnp.arange(b)
     x = linear_ops.apply_embedding(
         params["embed"], tokens[:, None], compute_dtype=policy.compute_dtype
     )
@@ -104,8 +129,6 @@ def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
     )
     cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
     layer_stack = policy.cast_to_compute(params["layers"])
-    valid = (jnp.arange(max_len)[None, :] <= pos[:, None])  # [b, max_len]
-    neg = jnp.asarray(jnp.finfo(policy.softmax_dtype).min / 2, policy.softmax_dtype)
 
     def body(x, inp):
         lp, ck, cv = inp  # ck/cv [b, max_len, nkv, d]
@@ -114,24 +137,11 @@ def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
         q, k, v = _qkv(lp["attn"], hidden, cfg)  # [b, 1, ., d]
         q = rope_ops.apply_rope(q, cos, sin)
         k = rope_ops.apply_rope(k, cos, sin)
-        ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
-        kk = jnp.repeat(ck, nh // nkv, axis=2) if nkv != nh else ck
-        vv = jnp.repeat(cv, nh // nkv, axis=2) if nkv != nh else cv
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kk, preferred_element_type=policy.softmax_dtype
-        ) * (1.0 / (d ** 0.5))
-        if cfg.sliding_window is not None:
-            win_ok = (jnp.arange(max_len)[None, :]
-                      > pos[:, None] - cfg.sliding_window)
-            mask = valid & win_ok
-        else:
-            mask = valid
-        scores = jnp.where(mask[:, None, None, :], scores, neg)
-        probs = jax.nn.softmax(scores.astype(policy.softmax_dtype), axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vv.dtype), vv)
-        out = out.reshape(b, 1, nh * d).astype(x.dtype)
-        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out)
+        out, ck, cv = _cached_attn(
+            q, k, v, ck, cv, pos, sliding_window=cfg.sliding_window,
+            softmax_dtype=policy.softmax_dtype,
+        )
+        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
         residual = x
         hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=cfg.rms_norm_eps)
         x = residual + llama._mlp_block(lp["mlp"], hidden)
@@ -141,6 +151,191 @@ def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
     h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=cfg.rms_norm_eps)
     logits = llama.logits_fn(params, h, cfg, policy)
     return logits[:, 0], {"k": ck, "v": cv}
+
+
+# ---------------------------------------------------------------------------
+# Mixtral / Megatron-GPT families
+# ---------------------------------------------------------------------------
+
+
+def prefill_mixtral(params, input_ids, cfg, policy, *, max_len=None):
+    """Mixtral prefill: llama structure with the MoE MLP slot."""
+    from neuronx_distributed_training_tpu.models import mixtral
+
+    if cfg.moe_frequency != 1:
+        raise NotImplementedError("cached decode with moe_frequency > 1")
+    if not cfg.moe.dropless:
+        # capacity-factor routing computes capacity over the CURRENT batch:
+        # a b-token decode step would contend for a tiny capacity and zero
+        # over-capacity tokens, silently diverging from generate()
+        raise NotImplementedError(
+            "cached decode with dropped (capacity-factor) MoE; use dropless"
+        )
+    lc = cfg.llama
+    s = input_ids.shape[1]
+    max_len = max_len or s
+    aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
+    x = linear_ops.apply_embedding(
+        params["embed"], input_ids, compute_dtype=policy.compute_dtype
+    )
+    x = shd.constrain(x, aspec)
+    cos, sin = llama._rope_for(input_ids, lc)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(x, lp):
+        x, _aux, (k, v) = mixtral._decoder_layer(
+            lp, x, cos, sin, cfg, policy, return_kv=True
+        )
+        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
+    h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
+    return h, {"k": ck, "v": cv}
+
+
+def decode_step_mixtral(params, cache, tokens, pos, cfg, policy):
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+    lc = cfg.llama
+    x = linear_ops.apply_embedding(
+        params["embed"], tokens[:, None], compute_dtype=policy.compute_dtype
+    )
+    inv_freq = rope_ops.rope_frequencies(
+        lc.head_size, theta=lc.rope_theta,
+        position_interpolation_factor=lc.rope_interpolation_factor,
+    )
+    cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(x, inp):
+        lp, ck, cv = inp
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
+        q, k, v = _qkv(lp["attn"], hidden, lc)
+        q = rope_ops.apply_rope(q, cos, sin)
+        k = rope_ops.apply_rope(k, cos, sin)
+        out, ck, cv = _cached_attn(
+            q, k, v, ck, cv, pos, sliding_window=lc.sliding_window,
+            softmax_dtype=policy.softmax_dtype,
+        )
+        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
+        hidden, _aux = moe_ops.moe_block(
+            lp["mlp"], hidden, cfg.moe, compute_dtype=policy.compute_dtype
+        )
+        x = residual + hidden
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
+    h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
+    logits = llama.logits_fn(params, h, lc, policy)
+    return logits[:, 0], {"k": ck, "v": cv}
+
+
+def prefill_gpt(params, input_ids, cfg, policy, *, max_len=None):
+    """Megatron-GPT prefill (learned-abs or rope, ln/rms, bias, tied head)."""
+    from neuronx_distributed_training_tpu.models import gpt
+
+    if cfg.moe is not None and not cfg.moe.dropless:
+        raise NotImplementedError(
+            "cached decode with dropped (capacity-factor) MoE; use dropless"
+        )
+    s = input_ids.shape[1]
+    max_len = max_len or s
+    positions = llama.positions_for(input_ids)
+    x = linear_ops.apply_embedding(
+        params["embed"], input_ids, compute_dtype=policy.compute_dtype
+    )
+    if cfg.position_embedding_type == "learned_absolute":
+        x = x + jnp.take(
+            params["pos_embed"]["embedding"], positions, axis=0
+        ).astype(x.dtype)
+    cos, sin = gpt._rope_for(cfg, input_ids, positions=positions)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(x, lp):
+        x, _aux, (k, v) = gpt._decoder_layer(
+            cfg, lp, x, cos, sin, policy, None, return_kv=True
+        )
+        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
+    h = gpt._apply_norm(cfg, params["final_norm"], x)
+    return h, {"k": ck, "v": cv}
+
+
+def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
+    from neuronx_distributed_training_tpu.models import gpt
+
+    b = tokens.shape[0]
+    nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
+    x = linear_ops.apply_embedding(
+        params["embed"], tokens[:, None], compute_dtype=policy.compute_dtype
+    )
+    if cfg.position_embedding_type == "learned_absolute":
+        x = x + jnp.take(
+            params["pos_embed"]["embedding"], pos[:, None], axis=0
+        ).astype(x.dtype)
+        cos = sin = None
+    else:
+        rot_dim = int(cfg.head_size * cfg.rotary_percentage) // 2 * 2
+        inv_freq = rope_ops.rope_frequencies(rot_dim, theta=cfg.rope_theta)
+        cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
+    layer_stack = policy.cast_to_compute(params["layers"])
+
+    def body(x, inp):
+        lp, ck, cv = inp
+        residual = x
+        hidden = gpt._apply_norm(cfg, lp["input_norm"], x)
+        qkv = linear_ops.apply_linear(lp["attn"]["qkv"], hidden)
+        q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
+        q = q.reshape(b, 1, nh, d)
+        k = k.reshape(b, 1, nkv, d)
+        v = v.reshape(b, 1, nkv, d)
+        if cos is not None:
+            if cfg.rotary_percentage < 1.0:
+                rot = int(d * cfg.rotary_percentage) // 2 * 2
+                q = jnp.concatenate(
+                    [rope_ops.apply_rope(q[..., :rot], cos, sin), q[..., rot:]], -1)
+                k = jnp.concatenate(
+                    [rope_ops.apply_rope(k[..., :rot], cos, sin), k[..., rot:]], -1)
+            else:
+                q = rope_ops.apply_rope(q, cos, sin)
+                k = rope_ops.apply_rope(k, cos, sin)
+        out, ck, cv = _cached_attn(
+            q, k, v, ck, cv, pos, sliding_window=cfg.sliding_window,
+            softmax_dtype=policy.softmax_dtype,
+        )
+        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
+        residual = x
+        hidden = gpt._apply_norm(cfg, lp["post_attn_norm"], x)
+        hidden, _aux = gpt._mlp_block(cfg, lp["mlp"], hidden, policy)
+        x = residual + hidden
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
+    h = gpt._apply_norm(cfg, params["final_norm"], x)
+    logits = gpt._logits_from_hidden(params, h, cfg, policy)
+    return logits[:, 0], {"k": ck, "v": cv}
+
+
+def _family(cfg):
+    """(prefill_fn, decode_fn, logits_cfg_for_head) by config type."""
+    from neuronx_distributed_training_tpu.models import gpt, mixtral
+
+    if isinstance(cfg, mixtral.MixtralConfig):
+        return (prefill_mixtral, decode_step_mixtral,
+                lambda params, h, policy: llama.logits_fn(
+                    params, h, cfg.llama, policy))
+    if isinstance(cfg, gpt.GPTConfig):
+        return (prefill_gpt, decode_step_gpt,
+                lambda params, h, policy: gpt._logits_from_hidden(
+                    params, h, cfg, policy))
+    return (prefill, decode_step,
+            lambda params, h, policy: llama.logits_fn(params, h, cfg, policy))
 
 
 def generate_cached(
@@ -170,9 +365,10 @@ def generate_cached(
     buf = buf.at[:, :plen].set(prompt_ids)
     if max_new_tokens <= 0:  # same no-op contract as generate()
         return buf
-    h, cache = prefill(params, prompt_ids, cfg, policy, max_len=total)
+    prefill_fn, decode_fn, head_fn = _family(cfg)
+    h, cache = prefill_fn(params, prompt_ids, cfg, policy, max_len=total)
     # logits ONLY at each row's last prompt position ([b, 1, h] -> [b, vocab])
-    logits = llama.logits_fn(params, h[rows, lens - 1][:, None], cfg, policy)[:, 0]
+    logits = head_fn(params, h[rows, lens - 1][:, None], policy)[:, 0]
     key = key if key is not None else jax.random.PRNGKey(0)
 
     def pick(next_logits, key):
@@ -194,7 +390,7 @@ def generate_cached(
         buf, cache, done, key = carry
         pos = lens + i  # position holding the PREVIOUS token
         prev = buf[rows, pos]
-        logits, cache = decode_step(params, cache, prev, pos, cfg, policy)
+        logits, cache = decode_fn(params, cache, prev, pos, cfg, policy)
         nxt, key = pick(logits, key)
         nxt = jnp.where(done, jnp.asarray(pad_id, buf.dtype), nxt.astype(buf.dtype))
         buf = buf.at[rows, pos + 1].set(nxt)

@@ -271,7 +271,8 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0)
 
 
-def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None):
+def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
+                     return_kv=False):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     qkv = linear_ops.apply_linear(lp["qkv"], x)
@@ -297,7 +298,10 @@ def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None):
         sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
         attention_mask=attention_mask,
     )
-    return linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
+    out = linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
+    if return_kv:
+        return out, (k, v)
+    return out
 
 
 def _mlp_block(cfg, lp, x, policy):
@@ -313,7 +317,7 @@ def _mlp_block(cfg, lp, x, policy):
 
 
 def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
-                   attention_mask=None):
+                   attention_mask=None, return_kv=False):
     aspec = shd.act_spec(cfg.sequence_parallel, False)
     k1 = k2 = None
     if dropout_key is not None:
@@ -321,12 +325,18 @@ def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
     residual = x
     hidden = _apply_norm(cfg, lp["input_norm"], x)
     hidden = _attention_block(cfg, lp["attn"], hidden, cos, sin, policy,
-                              attention_mask=attention_mask)
+                              attention_mask=attention_mask,
+                              return_kv=return_kv)
+    kv = None
+    if return_kv:
+        hidden, kv = hidden
     x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k1), aspec)
     residual = x
     hidden = _apply_norm(cfg, lp["post_attn_norm"], x)
     hidden, aux_loss = _mlp_block(cfg, lp["mlp"], hidden, policy)
     x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k2), aspec)
+    if return_kv:
+        return x, aux_loss, kv
     return x, aux_loss
 
 

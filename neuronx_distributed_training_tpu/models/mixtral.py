@@ -151,14 +151,18 @@ def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
 
 
 def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
-                   attention_mask=None):
-    """Pre-LN attention + MoE block; returns (x, aux_loss)."""
+                   attention_mask=None, return_kv=False):
+    """Pre-LN attention + MoE block; returns (x, aux_loss[, (k, v)])."""
     lc = cfg.llama
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
     residual = x
     hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
     hidden = llama._attention_block(lp["attn"], hidden, cos, sin, lc, policy,
-                                    attention_mask=attention_mask)
+                                    attention_mask=attention_mask,
+                                    return_kv=return_kv)
+    kv = None
+    if return_kv:
+        hidden, kv = hidden
     x = shd.constrain(residual + hidden, aspec)
     residual = x
     hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
@@ -166,7 +170,10 @@ def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
         lp["mlp"], hidden, cfg.moe, compute_dtype=policy.compute_dtype
     )
     aux_loss = moe_ops.weighted_router_loss(aux["router_logits"], aux["expert_idx"], cfg.moe)
-    return shd.constrain(residual + hidden, aspec), aux_loss
+    x = shd.constrain(residual + hidden, aspec)
+    if return_kv:
+        return x, aux_loss, kv
+    return x, aux_loss
 
 
 def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,

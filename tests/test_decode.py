@@ -92,3 +92,51 @@ class TestCachedDecode:
         out = decode.generate_cached(params, cfg, FP32, ids, lens,
                                      max_new_tokens=6, eos_id=96, pad_id=0)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestFamilyDecode:
+    """Cached decode parity for the Mixtral and Megatron-GPT families."""
+
+    def test_mixtral_greedy_parity(self):
+        from neuronx_distributed_training_tpu.models import mixtral
+        from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+        cfg = mixtral.MixtralConfig(
+            llama=CFG, moe=moe_ops.MoEConfig(num_experts=4, top_k=2,
+                                             dropless=True),
+        )
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        prompts = [[5, 6, 7, 8], [10, 11]]
+        ids, lens = pad_prompts(prompts, pad_id=0)
+
+        def logits_of(p, buf):
+            return mixtral.forward(p, {"input_ids": buf}, cfg, FP32)[0]
+
+        ref = generate(params, ids, lens, logits_of, max_new_tokens=8,
+                       eos_id=96, pad_id=0)
+        out = decode.generate_cached(params, cfg, FP32, ids, lens,
+                                     max_new_tokens=8, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    @pytest.mark.parametrize("pe", ["rope", "learned_absolute"])
+    def test_gpt_greedy_parity(self, pe):
+        from neuronx_distributed_training_tpu.models import gpt
+
+        cfg = gpt.GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_attention_heads=4,
+            num_query_groups=2, max_position_embeddings=64,
+            position_embedding_type=pe,
+            activations_checkpoint_granularity=None,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        prompts = [[5, 6, 7, 8, 9], [10, 11, 12]]
+        ids, lens = pad_prompts(prompts, pad_id=0)
+
+        def logits_of(p, buf):
+            return gpt.forward(p, {"input_ids": buf}, cfg, FP32)[0]
+
+        ref = generate(params, ids, lens, logits_of, max_new_tokens=8,
+                       eos_id=96, pad_id=0)
+        out = decode.generate_cached(params, cfg, FP32, ids, lens,
+                                     max_new_tokens=8, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
