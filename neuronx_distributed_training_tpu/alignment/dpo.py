@@ -19,8 +19,20 @@ import numpy as np
 
 from neuronx_distributed_training_tpu.alignment.losses import dpo_loss, sequence_logprobs
 
-# ForwardLogits: (params, batch) -> logits [b, s, vocab]
-ForwardLogits = Callable[[Any, dict], jax.Array]
+# ForwardLogits: (params, batch[, rng]) -> logits [b, s, vocab], or
+# (logits, reg_loss) where reg_loss is the model's auxiliary regularizer
+# (MoE router balance) to keep alongside the preference objective
+ForwardLogits = Callable[..., Any]
+
+
+def _call_forward(forward_logits, params, batch, rng=None):
+    try:
+        out = forward_logits(params, batch, rng)
+    except TypeError:  # two-arg legacy forward
+        out = forward_logits(params, batch)
+    if isinstance(out, tuple):
+        return out
+    return out, 0.0
 
 
 def compute_reference_logprobs(
@@ -40,7 +52,9 @@ def compute_reference_logprobs(
     def one(params, batch):
         out = {}
         for side in ("chosen", "rejected"):
-            logits = forward_logits(params, {"input_ids": batch[f"{side}_input_ids"]})
+            logits, _reg = _call_forward(
+                forward_logits, params, {"input_ids": batch[f"{side}_input_ids"]}
+            )
             out[side] = sequence_logprobs(
                 logits, batch[f"{side}_input_ids"], batch.get(f"{side}_loss_mask")
             )
@@ -126,20 +140,28 @@ def make_dpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):
     columns from ``compute_reference_logprobs``.
     """
 
-    def loss_fn(params, batch, _key):
+    def loss_fn(params, batch, key):
+        kc = kr = None
+        if key is not None:
+            kc, kr = jax.random.split(key)
+        lc, reg_c = _call_forward(
+            forward_logits, params,
+            {"input_ids": batch["chosen_input_ids"]}, kc)
         pc = sequence_logprobs(
-            forward_logits(params, {"input_ids": batch["chosen_input_ids"]}),
-            batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
+            lc, batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
         )
+        lr, reg_r = _call_forward(
+            forward_logits, params,
+            {"input_ids": batch["rejected_input_ids"]}, kr)
         pr = sequence_logprobs(
-            forward_logits(params, {"input_ids": batch["rejected_input_ids"]}),
-            batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
+            lr, batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
         )
         loss, metrics = dpo_loss(
             pc, pr,
             batch["reference_chosen_logps"], batch["reference_rejected_logps"],
             beta=beta,
         )
-        return loss, metrics
+        reg = 0.5 * (reg_c + reg_r)  # MoE router balance rides along
+        return loss + reg, metrics
 
     return loss_fn

@@ -11,6 +11,7 @@ columns — and it consumes the same DPO-shaped batches
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from neuronx_distributed_training_tpu.alignment.dpo import ForwardLogits
@@ -27,15 +28,24 @@ def make_orpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):
     ``*_loss_mask``).  Unlike DPO there are no reference columns.
     """
 
-    def loss_fn(params, batch, _key):
+    def loss_fn(params, batch, key):
+        from neuronx_distributed_training_tpu.alignment.dpo import _call_forward
+
+        kc = kr = None
+        if key is not None:
+            kc, kr = jax.random.split(key)
+        lc, reg_c = _call_forward(
+            forward_logits, params,
+            {"input_ids": batch["chosen_input_ids"]}, kc)
         pc = sequence_logprobs(
-            forward_logits(params, {"input_ids": batch["chosen_input_ids"]}),
-            batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
+            lc, batch["chosen_input_ids"], batch.get("chosen_loss_mask"),
             average=True,
         )
+        lr, reg_r = _call_forward(
+            forward_logits, params,
+            {"input_ids": batch["rejected_input_ids"]}, kr)
         pr = sequence_logprobs(
-            forward_logits(params, {"input_ids": batch["rejected_input_ids"]}),
-            batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
+            lr, batch["rejected_input_ids"], batch.get("rejected_loss_mask"),
             average=True,
         )
         # reference base_orpo.py:33 — the chosen NLL term is the mean of the
@@ -44,6 +54,7 @@ def make_orpo_loss_fn(forward_logits: ForwardLogits, *, beta: float = 0.1):
         loss, metrics = orpo_loss(pc, pr, nll, beta=beta)
         metrics["rewards_chosen"] = beta * jnp.mean(pc)
         metrics["rewards_rejected"] = beta * jnp.mean(pr)
-        return loss, metrics
+        reg = 0.5 * (reg_c + reg_r)  # MoE router balance rides along
+        return loss + reg, metrics
 
     return loss_fn

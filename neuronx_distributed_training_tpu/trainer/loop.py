@@ -135,16 +135,8 @@ class Trainer:
         # reference-logprob pass runs in fit() (reference base_dpo.py:23-66),
         # ORPO needs no reference model (reference base_orpo.py:26-46)
         if alignment in ("dpo", "orpo"):
-            if not isinstance(model_cfg, llama.LlamaConfig):
-                raise NotImplementedError(
-                    f"{alignment.upper()} is wired for the llama family only"
-                )
             dpo_cfg = dict((cfg.get("model", {}) or {}).get(alignment, {}) or {})
-            mc_ref = model_cfg
-
-            def forward_logits(p, batch):
-                out, _ = llama.forward(p, {"input_ids": batch["input_ids"]}, mc_ref, policy)
-                return out
+            forward_logits = _forward_logits_for(model_cfg, policy)
 
             # reference spells it kl_beta in the strategy block
             beta = float(align_params.get("kl_beta", dpo_cfg.get("beta", 0.1)))
@@ -201,10 +193,10 @@ class Trainer:
                 # preference losses pipeline via the concatenated forward
                 # (reference base_dpo.py:68-88 runs chosen+rejected through
                 # NxDPPModel as one doubled batch)
-                if vp > 1 and alignment == "dpo":
+                if not isinstance(model_cfg, llama.LlamaConfig):
                     raise NotImplementedError(
-                        "DPO + interleaved pipeline (vp > 1): the pre-fit "
-                        "reference pass needs the flat layer layout"
+                        f"{alignment.upper()} + pipeline parallelism is wired "
+                        f"for the llama family only"
                     )
                 from neuronx_distributed_training_tpu.alignment.dpo import (
                     preference_pipeline_hooks,
@@ -386,11 +378,26 @@ class Trainer:
                     {k: v[order[i:i + bs]] for k, v in dm.arrays.items()}
                     for i in range(0, n - bs + 1, bs)
                 )
-                cols = compute_reference_logprobs(trainer.params, batches, forward_logits)
+                ref_params = trainer.params
+                # interleaving only happens when the pipeline branch ran
+                # (pp > 1 AND vp > 1); gate on both or a flat stack would be
+                # "de-interleaved" into garbage shapes
+                vp_now = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
+                if pp > 1 and vp_now > 1:
+                    # interleaved layout -> flat [L] for the plain forward
+                    # (a reshape; the reference pass is compute-once)
+                    from neuronx_distributed_training_tpu.parallel.pipeline import (
+                        from_interleaved,
+                    )
+
+                    ref_params = dict(trainer.params)
+                    ref_params["layers"] = from_interleaved(
+                        trainer.params["layers"])
+                cols = compute_reference_logprobs(ref_params, batches, forward_logits)
                 # trailing partial batch (if any) computed on the remainder
                 if n % bs:
                     rem = {k: v[order[n - (n % bs):]] for k, v in dm.arrays.items()}
-                    extra = compute_reference_logprobs(trainer.params, [rem], forward_logits)
+                    extra = compute_reference_logprobs(ref_params, [rem], forward_logits)
                     cols = {k: np.concatenate([cols[k], extra[k]]) for k in cols}
                 dm.attach_reference_logprobs(cols)
                 if sidecar is not None:
@@ -618,6 +625,43 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = Tr
             lambda **kw: gpt.param_specs(gc, **kw),
         )
     raise ValueError(f"unsupported model_source/architecture: {source}/{arch}")
+
+
+def _forward_logits_for(model_cfg: Any, policy: DtypePolicy):
+    """``(params, batch, rng=None) -> (logits, reg_loss)`` for any family —
+    the preference losses' policy forward.
+
+    ``reg_loss`` is the model's auxiliary regularizer (Mixtral/GPT-MoE router
+    load-balancing term; 0.0 for dense models) so preference training keeps
+    the same expert-balance pressure as the LM loss path.  ``rng`` threads
+    dropout for GPT policy forwards (None during the frozen reference pass).
+    """
+    if isinstance(model_cfg, llama.LlamaConfig):
+        def fwd(p, b, rng=None):
+            logits, _ = llama.forward(
+                p, {"input_ids": b["input_ids"]}, model_cfg, policy)
+            return logits, 0.0
+
+        return fwd
+    from neuronx_distributed_training_tpu.models import gpt, mixtral
+
+    if isinstance(model_cfg, mixtral.MixtralConfig):
+        def fwd(p, b, rng=None):
+            logits, aux = mixtral.forward(
+                p, {"input_ids": b["input_ids"]}, model_cfg, policy)
+            return logits, aux["router_aux_loss"]
+
+        return fwd
+    if isinstance(model_cfg, gpt.GPTConfig):
+        def fwd(p, b, rng=None):
+            logits, aux = gpt.forward(
+                p, {"input_ids": b["input_ids"]}, model_cfg, policy, rng=rng)
+            return logits, aux.get("router_aux_loss", 0.0)
+
+        return fwd
+    raise NotImplementedError(
+        f"preference alignment not wired for {type(model_cfg).__name__}"
+    )
 
 
 def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
