@@ -127,10 +127,6 @@ def init_params(key: jax.Array, cfg: MixtralConfig, policy: DtypePolicy | None =
 
 
 def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
-    if pipeline and cfg.moe_frequency != 1:
-        raise NotImplementedError(
-            "pipeline parallelism with moe_frequency > 1 not supported yet"
-        )
     specs = llama.param_specs(cfg.llama, pipeline=pipeline)
     lead = "pipe" if pipeline else None
     moe_specs = jax.tree_util.tree_map(
@@ -148,6 +144,42 @@ def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
         )
         specs["layers"]["mlp"] = {"moe": moe_specs, "dense": dense_specs}
     return specs
+
+
+def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
+                  attention_mask=None):
+    """(xs, body) for the dense/MoE interleave scan over [G] groups.
+
+    Shared by ``forward`` and the pipeline ``stage_fn``: each group runs one
+    MoE layer then ``f-1`` dense llama layers; groups are contiguous runs of
+    ``f`` layers, so any contiguous slice of the flat attn/norm stack aligns
+    with the matching moe/dense group slices.
+    """
+    f = cfg.moe_frequency
+    gc = jax.tree_util.tree_leaves(layer_stack["mlp"]["moe"])[0].shape[0]
+    lc = cfg.llama
+    shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
+    head = jax.tree_util.tree_map(
+        lambda a: a.reshape((gc, f) + a.shape[1:])[:, 0], shared)
+    tail = jax.tree_util.tree_map(
+        lambda a: a.reshape((gc, f) + a.shape[1:])[:, 1:], shared)
+    xs = {"moe": {**head, "mlp": layer_stack["mlp"]["moe"]},
+          "dense": {**tail, "mlp": layer_stack["mlp"]["dense"]}}
+
+    def body(carry, gp):
+        x, aux_acc = carry
+        x, aux = _decoder_layer(gp["moe"], x, cos, sin, cfg, policy,
+                                attention_mask=attention_mask)
+
+        def dense_body(x2, dlp):
+            return llama._decoder_layer(
+                dlp, x2, cos, sin, lc, policy, attention_mask=attention_mask,
+            ), None
+
+        x, _ = jax.lax.scan(dense_body, x, gp["dense"])
+        return (x, aux_acc + aux), None
+
+    return xs, body
 
 
 def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
@@ -184,12 +216,9 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
     aux-loss accumulates per stage and crosses pipe ranks as a psum'd scalar —
     the TPU-native form of the reference threading ``past_router_logits``
     through pipeline stages (``modeling_mixtral.py:440-549``).  The caller
-    scales the psum'd total by ``1 / (num_microbatches * num_layers)``.
+    scales the psum'd total by ``1 / (num_microbatches * num_moe_layers(cfg))``
+    (only router-bearing layers contribute).
     """
-    if cfg.moe_frequency != 1:
-        raise NotImplementedError(
-            "pipeline parallelism with moe_frequency > 1 not supported yet"
-        )
     lc = cfg.llama
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
 
@@ -202,15 +231,22 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
 
     def stage_fn(local_layers, x, mb):
         cos, sin = llama._rope_for(mb["input_ids"], lc)
-        local_layers = policy.cast_to_compute(local_layers)
+        ll = policy.cast_to_compute(local_layers)
 
-        def body(carry, lp):
-            x, aux_acc = carry
-            x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
-            return (x, aux_acc + aux), None
+        if cfg.moe_frequency == 1:
+
+            def body(carry, lp):
+                x, aux_acc = carry
+                x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
+                return (x, aux_acc + aux), None
+
+            xs = ll
+        else:
+            # grouped interleave on the LOCAL slice (see _grouped_scan)
+            xs, body = _grouped_scan(cfg, ll, cos, sin, policy)
 
         (x, aux_sum), _ = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)), local_layers
+            body, (x, jnp.zeros((), jnp.float32)), xs
         )
         return x, aux_sum
 
@@ -270,28 +306,8 @@ def forward(
         xs = layer_stack
     else:
         # grouped interleave: scan over [L/f] groups of (MoE + f-1 dense)
-        f, g = cfg.moe_frequency, num_moe_layers(cfg)
-        shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
-        head = jax.tree_util.tree_map(
-            lambda x: x.reshape((g, f) + x.shape[1:])[:, 0], shared)
-        tail = jax.tree_util.tree_map(
-            lambda x: x.reshape((g, f) + x.shape[1:])[:, 1:], shared)
-        xs = {"moe": {**head, "mlp": layer_stack["mlp"]["moe"]},
-              "dense": {**tail, "mlp": layer_stack["mlp"]["dense"]}}
-
-        def body(carry, gp):
-            x, aux_acc = carry
-            x, aux = _decoder_layer(gp["moe"], x, cos, sin, cfg, policy,
-                                    attention_mask=attention_mask)
-
-            def dense_body(x2, dlp):
-                return llama._decoder_layer(
-                    dlp, x2, cos, sin, lc, policy,
-                    attention_mask=attention_mask,
-                ), None
-
-            x, _ = jax.lax.scan(dense_body, x, gp["dense"])
-            return (x, aux_acc + aux), None
+        xs, body = _grouped_scan(cfg, layer_stack, cos, sin, policy,
+                                 attention_mask=attention_mask)
 
     if remat is not None:
         body = jax.checkpoint(body, policy=remat, prevent_cse=False)

@@ -187,7 +187,25 @@ class Trainer:
 
             vp = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
             # fail early with a clear message instead of an opaque GSPMD error
-            stage_layer_slice(int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
+            moe_freq = int(getattr(model_cfg, "moe_frequency", 1) or 1)
+            if moe_freq != 1:
+                if vp > 1:
+                    raise NotImplementedError(
+                        "interleaved pipeline (vp > 1) with moe_frequency > 1"
+                    )
+                # pipe slices whole (MoE + dense) groups
+                from neuronx_distributed_training_tpu.models import mixtral as _mx
+
+                groups = _mx.num_moe_layers(model_cfg)
+                if groups % pp != 0:
+                    raise ValueError(
+                        f"num_layers {model_cfg.num_layers} / moe frequency "
+                        f"{moe_freq} = {groups} groups, not divisible by "
+                        f"pipeline_model_parallel_size {pp}"
+                    )
+            else:
+                stage_layer_slice(
+                    int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
             nm = sched["num_microbatches"]
             if alignment in ("dpo", "orpo"):
                 # preference losses pipeline via the concatenated forward
@@ -681,7 +699,9 @@ def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
     if isinstance(model_cfg, mixtral.MixtralConfig):
         return (
             mixtral.pipeline_hooks(model_cfg, policy, shift_labels=shift_labels),
-            {"stage_aux": True, "aux_inv_layers": 1.0 / model_cfg.num_layers},
+            # normalized over the layers that HAVE routers (moe_frequency)
+            {"stage_aux": True,
+             "aux_inv_layers": 1.0 / mixtral.num_moe_layers(model_cfg)},
         )
     if isinstance(model_cfg, gpt.GPTConfig):
         opts = {

@@ -397,3 +397,62 @@ class TestPreferencePipeline:
         )
         back = from_interleaved(inter)
         np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(x["w"]))
+
+
+def test_mixtral_interleaved_pp2_matches_reference(devices8):
+    """moe_frequency=2 under pp=2: grouped stage slicing (whole MoE+dense
+    groups per rank) matches the per-microbatch unpipelined forward."""
+    import dataclasses
+
+    from neuronx_distributed_training_tpu.models import mixtral
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+    cfg = mixtral.MixtralConfig(
+        llama=dataclasses.replace(CFG, num_layers=8),
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True,
+                              router_aux_loss_coef=0.02),
+        moe_frequency=2,
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+    mbs = microbatches(jax.random.PRNGKey(1))
+    nm = mbs["input_ids"].shape[0]
+
+    def ref(p, m):
+        def body(acc, mb):
+            loss, _ = mixtral.forward(p, mb, cfg, FP32)
+            return acc + loss, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+        return total / nm
+
+    ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+    embed_fn, stage_fn, loss_fn = mixtral.pipeline_hooks(cfg, FP32)
+
+    def pl(p, m):
+        return pipeline_loss(
+            p, p["layers"], m,
+            embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+            mesh=mesh, stage_aux=True,
+            aux_scale=1.0 / (nm * mixtral.num_moe_layers(cfg)),
+        )
+
+    specs = mixtral.param_specs(cfg, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    with mesh, shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    for path in (("layers", "mlp", "moe", "router", "w"),
+                 ("layers", "mlp", "dense", "gate_up", "w"),
+                 ("embed", "embedding")):
+        g, rg = grads, ref_g
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {path}",
+        )

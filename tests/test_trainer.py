@@ -328,3 +328,23 @@ def test_dpo_vpp_trainer(tmp_path, devices8):
     m = t.fit()
     assert np.isfinite(m["loss"])
     assert "reference_chosen_logps" in dm.arrays
+
+
+def test_mixtral_pipeline_trainer(tmp_path, devices8):
+    """Trainer wiring for mixtral under pp=2 (router aux psum through the
+    pipelined loss), incl. moe_frequency=2 grouped stage slicing."""
+    for freq in (1, 2):
+        cfg = tiny_cfg(tmp_path, max_steps=1,
+                       exp_manager={"exp_dir": str(tmp_path / f"exp_f{freq}")})
+        cfg["model"]["architecture"] = "mixtral"
+        cfg["model"]["num_layers"] = 4
+        cfg["model"]["moe"] = {"num_experts": 2, "top_k": 1, "dropless": True,
+                               "frequency": freq}
+        cfg["distributed_strategy"] = {
+            "pipeline_model_parallel_size": 2,
+            "tensor_model_parallel_size": 2,
+            "sequence_parallel": True,
+        }
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        m = t.fit()
+        assert np.isfinite(m["loss"]), f"frequency={freq}"
