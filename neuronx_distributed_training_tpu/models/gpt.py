@@ -96,6 +96,14 @@ class GPTConfig:
         moe_block = m.get("moe") or (
             {"num_experts": m["num_moe_experts"]} if m.get("num_moe_experts") else None
         )
+        if moe_block and int(moe_block.get("frequency", 1) or 1) != 1:
+            # the reference reads moe frequency for the megatron family too
+            # (megatron_gpt_model.py:137); the interleaved layout lives in the
+            # mixtral family here — don't silently train all-MoE
+            raise NotImplementedError(
+                "moe.frequency > 1 for the megatron/gpt family: use "
+                "architecture: mixtral (dense/MoE interleave) instead"
+            )
         return cls(
             vocab_size=int(m.get("vocab_size", 50257)),
             hidden_size=int(m.get("hidden_size", 1024)),
