@@ -113,7 +113,8 @@ def acquire_device(retries: int = 3, probe_timeout_s: float = 180.0,
 
 
 def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | None,
-                hbm_bytes: int, bytes_per_param: float):
+                hbm_bytes: int, bytes_per_param: float,
+                block_q: int | None = None, block_kv: int | None = None):
     """Llama-3-8B per-layer shapes, layer count auto-sized to HBM."""
     if on_tpu:
         h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
@@ -133,6 +134,8 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
             rope_theta=500000.0,
             fuse_qkv=True,
             attention_impl=attn_impl,
+            flash_block_q=block_q,
+            flash_block_kv=block_kv,
             activations_checkpoint_granularity="selective",
         )
     return llama.LlamaConfig(
@@ -243,6 +246,9 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=None)
     ap.add_argument("--mbs", type=int, default=1)
     ap.add_argument("--attn", choices=["auto", "core", "flash"], default="auto")
+    ap.add_argument("--block-q", type=int, default=None,
+                    help="flash tile override (per-chip tuning sweep)")
+    ap.add_argument("--block-kv", type=int, default=None)
     ap.add_argument("--regime", choices=["both", "mixed", "bf16"], default="both")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a platform (cpu for local smoke runs)")
@@ -293,7 +299,8 @@ def main() -> None:
     errors: dict[str, str] = {}
     for name in wanted:
         policy, bpp = regimes[name]
-        cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp)
+        cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp,
+                          args.block_q, args.block_kv)
         log(f"bench[{name}]: device={dev.device_kind} layers={cfg.num_layers} "
             f"seq={seq} mbs={args.mbs} attn={cfg.attention_impl}")
         # OOM backoff: fewer layers, then tied embed+head (halves the 1.05B

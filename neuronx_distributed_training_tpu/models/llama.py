@@ -63,6 +63,8 @@ class LlamaConfig:
     # parallel / fusion behavior
     fuse_qkv: bool = True
     attention_impl: str = "core"  # "core" | "flash" | "ring"
+    flash_block_q: Optional[int] = None   # Pallas tile override (perf tuning)
+    flash_block_kv: Optional[int] = None
     sequence_parallel: bool = False
     context_parallel: bool = False
     activations_checkpoint_granularity: Optional[str] = "selective"
@@ -105,6 +107,8 @@ class LlamaConfig:
             sliding_window=m.get("sliding_window"),
             fuse_qkv=bool(m.get("fuse_qkv", True)),
             attention_impl=impl,
+            flash_block_q=fusions.get("flash_block_q"),
+            flash_block_kv=fusions.get("flash_block_kv"),
             sequence_parallel=bool(ds.get("sequence_parallel", False)),
             context_parallel=int(ds.get("context_parallel_size", 1)) > 1,
             activations_checkpoint_granularity=m.get(
@@ -257,6 +261,8 @@ def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
         sliding_window=cfg.sliding_window,
         softmax_dtype=policy.softmax_dtype,
         attention_mask=attention_mask,
+        block_q=cfg.flash_block_q,
+        block_kv=cfg.flash_block_kv,
     )
     out = out.reshape(b, s, nh * d)
     # RowParallel o_proj; reduce(-scatter under SP) inserted by GSPMD

@@ -130,6 +130,8 @@ def attention(
     sliding_window: Optional[int] = None,
     softmax_dtype=jnp.float32,
     attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = attend
+    block_q: Optional[int] = None,   # Pallas flash tile sizes (None = default;
+    block_kv: Optional[int] = None,  # a per-chip tuning knob, fusions.flash_block_*)
 ) -> jax.Array:
     """Dispatch mirroring the reference's flash/ring/Core selection
     (``modeling_llama.py:482-489``).  Falls back to ``core_attention`` (with a
@@ -151,7 +153,8 @@ def attention(
             _warn_fallback("flash")
         else:
             return flash_attention(
-                q, k, v, causal=causal, sliding_window=sliding_window, q_offset=q_offset
+                q, k, v, causal=causal, sliding_window=sliding_window,
+                q_offset=q_offset, block_q=block_q, block_kv=block_kv,
             )
     if impl == "ring":
         try:
