@@ -147,6 +147,8 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
         num_kv_heads=4,
         max_position_embeddings=seq,
         attention_impl=attn_impl,
+        flash_block_q=block_q,
+        flash_block_kv=block_kv,
     )
 
 

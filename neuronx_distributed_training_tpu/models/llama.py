@@ -65,6 +65,7 @@ class LlamaConfig:
     attention_impl: str = "core"  # "core" | "flash" | "ring"
     flash_block_q: Optional[int] = None   # Pallas tile override (perf tuning)
     flash_block_kv: Optional[int] = None
+    vocab_chunks: Optional[int] = None    # fusions.chunked_ce: fused head+CE
     sequence_parallel: bool = False
     context_parallel: bool = False
     activations_checkpoint_granularity: Optional[str] = "selective"
@@ -109,6 +110,8 @@ class LlamaConfig:
             attention_impl=impl,
             flash_block_q=fusions.get("flash_block_q"),
             flash_block_kv=fusions.get("flash_block_kv"),
+            vocab_chunks=(int(fusions["chunked_ce"])
+                          if fusions.get("chunked_ce") else None),
             sequence_parallel=bool(ds.get("sequence_parallel", False)),
             context_parallel=int(ds.get("context_parallel_size", 1)) > 1,
             activations_checkpoint_granularity=m.get(
@@ -419,9 +422,30 @@ def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy, *, shift_labels: bool 
 
     def loss_fn(params, y, mb):
         h = norm_ops.apply_rms_norm(params["final_norm"], y, eps=cfg.rms_norm_eps)
-        logits = logits_fn(params, h, cfg, policy)
         labels = mb["labels"]
         loss_mask = mb.get("loss_mask")
+        head_plain = cfg.tie_word_embeddings or (
+            "lm_head" in params and "lora_a" not in params["lm_head"]
+        )
+        if cfg.vocab_chunks and head_plain:
+            # fused head+CE per microbatch: the [mb, s, vocab] logits never
+            # materialize — this is where the 405B-class config needs it
+            if shift_labels:
+                h2, labels2 = h[:, :-1], labels[:, 1:]
+                lm2 = None if loss_mask is None else loss_mask[:, 1:]
+            else:
+                h2, labels2, lm2 = h, labels, loss_mask
+            head_w = (params["embed"]["embedding"].T
+                      if cfg.tie_word_embeddings else params["lm_head"]["w"])
+            loss_sum = ce_ops.chunked_cross_entropy_from_hidden(
+                h2, head_w, labels2, num_chunks=cfg.vocab_chunks,
+                loss_mask=lm2, reduction="sum",
+            )
+            valid = (labels2 != -100).astype(jnp.float32)
+            if lm2 is not None:
+                valid = valid * lm2.astype(jnp.float32)
+            return loss_sum, jnp.sum(valid)
+        logits = logits_fn(params, h, cfg, policy)
         if shift_labels:
             logits, labels, loss_mask = ce_ops.shift_for_next_token(
                 logits, labels, loss_mask
@@ -458,11 +482,35 @@ def forward(
     attention_mask = batch.get("attention_mask")
     hidden = hidden_states(params, input_ids, cfg, policy, positions=positions,
                            attention_mask=attention_mask)
+    labels = batch.get("labels")
+    head_plain = cfg.tie_word_embeddings or (
+        "lm_head" in params and "lora_a" not in params["lm_head"]
+    )
+    if (cfg.vocab_chunks and labels is not None and not return_logits
+            and head_plain):  # an lm_head LoRA adapter needs apply_linear
+        # fused head+CE: the [b, s, vocab] logits are never materialized
+        # (see ce_ops.chunked_cross_entropy_from_hidden)
+        loss_mask = batch.get("loss_mask")
+        if attention_mask is not None:
+            am = attention_mask.astype(jnp.float32)
+            loss_mask = am if loss_mask is None else loss_mask * am
+        if shift_labels:
+            hidden = hidden[:, :-1]
+            labels = labels[:, 1:]
+            loss_mask = None if loss_mask is None else loss_mask[:, 1:]
+        if cfg.tie_word_embeddings:
+            head_w = params["embed"]["embedding"].T
+        else:
+            head_w = params["lm_head"]["w"]
+        loss = ce_ops.chunked_cross_entropy_from_hidden(
+            hidden, head_w, labels,
+            num_chunks=cfg.vocab_chunks, loss_mask=loss_mask,
+        )
+        return loss, {}
     logits = logits_fn(params, hidden, cfg, policy)
     aux: dict[str, Any] = {}
     if return_logits:
         aux["logits"] = logits
-    labels = batch.get("labels")
     if labels is None:
         return logits, aux
     loss_mask = batch.get("loss_mask")

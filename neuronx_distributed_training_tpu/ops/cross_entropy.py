@@ -79,3 +79,81 @@ def logprobs_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
     (reference ``from_parallel_logits_to_logprobs``, ``base_dpo.py:34-46``)."""
     label_logit, lse = _label_logit_and_lse(logits, labels)
     return label_logit - lse
+
+
+def chunked_cross_entropy_from_hidden(
+    hidden: jax.Array,   # [batch, seq, h] (compute dtype)
+    head_w: jax.Array,   # [h, vocab] lm-head weight (tied: embedding.T)
+    labels: jax.Array,   # [batch, seq]
+    *,
+    num_chunks: int = 8,
+    loss_mask: Optional[jax.Array] = None,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+) -> jax.Array:
+    """CE fused with the lm-head matmul, scanned over vocab chunks.
+
+    Never materializes the full ``[batch, seq, vocab]`` logits: each scan step
+    computes one ``[batch, seq, vocab/num_chunks]`` block, folds it into an
+    online logsumexp, and is rematerialized in backward (``jax.checkpoint``) —
+    peak activation memory drops from O(s·V) to O(s·V/num_chunks) at the cost
+    of one extra head-matmul pass in backward (~1/(3·num_layers) of step
+    FLOPs).  The memory lever for 128k-vocab models at long seq (the
+    405B-class config) and for PP loss hooks, opt-in via
+    ``model.fusions.chunked_ce``.
+
+    Note: designed for the unsharded-vocab case; under vocab-parallel TP the
+    standard ``cross_entropy_loss`` already partitions its reductions cleanly.
+    """
+    v = head_w.shape[-1]
+    if v % num_chunks != 0:
+        raise ValueError(f"vocab {v} not divisible by num_chunks {num_chunks}")
+    vc = v // num_chunks
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+
+    # static chunk layout: scan consumes [num_chunks, h, vc] as xs, so the
+    # partitioner sees analyzable slices (a traced dynamic_slice over a
+    # vocab-sharded weight would force a full all-gather per step)
+    w_chunks = jnp.moveaxis(
+        head_w.reshape(head_w.shape[0], num_chunks, vc), 1, 0
+    )
+
+    def body(carry, xs):
+        c, w_c = xs
+        m, l, label_logit = carry
+        logits_c = (hidden @ w_c.astype(hidden.dtype)).astype(jnp.float32)
+        m_c = jax.lax.stop_gradient(jnp.max(logits_c, axis=-1))
+        m_new = jnp.maximum(m, m_c)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits_c - m_new[..., None]), axis=-1
+        )
+        in_chunk = jax.lax.broadcasted_iota(
+            jnp.int32, logits_c.shape, logits_c.ndim - 1
+        ) == (safe_labels - c * vc)[..., None]
+        label_logit = label_logit + jnp.sum(
+            jnp.where(in_chunk, logits_c, 0.0), axis=-1
+        )
+        return (m_new, l, label_logit), None
+
+    b, s = labels.shape
+    init = (
+        jnp.full((b, s), -jnp.inf, jnp.float32),
+        jnp.zeros((b, s), jnp.float32),
+        jnp.zeros((b, s), jnp.float32),
+    )
+    (m, l, label_logit), _ = jax.lax.scan(
+        jax.checkpoint(body), init, (jnp.arange(num_chunks), w_chunks)
+    )
+    per_tok = (m + jnp.log(l)) - label_logit
+    mask = valid.astype(jnp.float32)
+    if loss_mask is not None:
+        mask = mask * loss_mask.astype(jnp.float32)
+    per_tok = per_tok * mask
+    if reduction == "none":
+        return per_tok
+    total = jnp.sum(per_tok)
+    if reduction == "sum":
+        return total
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom

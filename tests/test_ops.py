@@ -147,3 +147,74 @@ def test_logprobs_from_logits():
 def test_vocab_padding():
     assert linear_ops.pad_vocab_size(32000, 128, 4) == 32256
     assert linear_ops.pad_vocab_size(512, 128, 4) == 512
+
+
+class TestChunkedCE:
+    """Fused head+CE (chunked logsumexp) vs the standard two-step path."""
+
+    def test_loss_and_grads_match_standard(self):
+        from neuronx_distributed_training_tpu.ops.cross_entropy import (
+            chunked_cross_entropy_from_hidden,
+            cross_entropy_loss,
+        )
+
+        key = jax.random.PRNGKey(0)
+        h, v, b, s = 32, 96, 2, 10
+        hidden = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h))
+        w = jax.random.normal(jax.random.fold_in(key, 2), (h, v)) * 0.1
+        labels = jax.random.randint(jax.random.fold_in(key, 3), (b, s), 0, v)
+        labels = labels.at[0, 0].set(-100)  # ignore_index coverage
+        mask = jnp.ones((b, s)).at[1, :3].set(0.0)
+
+        def standard(hidden, w):
+            return cross_entropy_loss(hidden @ w, labels, loss_mask=mask)
+
+        def chunked(hidden, w):
+            return chunked_cross_entropy_from_hidden(
+                hidden, w, labels, num_chunks=8, loss_mask=mask)
+
+        ref, (gh_ref, gw_ref) = jax.value_and_grad(standard, argnums=(0, 1))(hidden, w)
+        got, (gh, gw) = jax.value_and_grad(chunked, argnums=(0, 1))(hidden, w)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(gh_ref),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_indivisible_raises(self):
+        from neuronx_distributed_training_tpu.ops.cross_entropy import (
+            chunked_cross_entropy_from_hidden,
+        )
+
+        with pytest.raises(ValueError, match="divisible"):
+            chunked_cross_entropy_from_hidden(
+                jnp.zeros((1, 2, 4)), jnp.zeros((4, 10)),
+                jnp.zeros((1, 2), jnp.int32), num_chunks=3)
+
+    def test_llama_forward_knob_matches(self):
+        import dataclasses
+
+        from neuronx_distributed_training_tpu.models import llama as llama_mod
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                           softmax_dtype=jnp.float32)
+        cfg = llama_mod.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        )
+        params = llama_mod.init_params(jax.random.PRNGKey(0), cfg, fp32)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 3, 64)
+        batch = {"input_ids": ids, "labels": ids}
+        ref, _ = llama_mod.forward(params, batch, cfg, fp32)
+        cfg2 = dataclasses.replace(cfg, vocab_chunks=4)
+        got, _ = llama_mod.forward(params, batch, cfg2, fp32)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+        # tied variant
+        cfg3 = dataclasses.replace(cfg, tie_word_embeddings=True)
+        params3 = llama_mod.init_params(jax.random.PRNGKey(0), cfg3, fp32)
+        ref3, _ = llama_mod.forward(params3, batch, cfg3, fp32)
+        got3, _ = llama_mod.forward(
+            params3, batch, dataclasses.replace(cfg3, vocab_chunks=4), fp32)
+        np.testing.assert_allclose(float(got3), float(ref3), rtol=1e-5)

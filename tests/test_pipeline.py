@@ -456,3 +456,38 @@ def test_mixtral_interleaved_pp2_matches_reference(devices8):
             np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
             err_msg=f"grad mismatch at {path}",
         )
+
+
+def test_chunked_ce_pp2_matches(devices8):
+    """fusions.chunked_ce in the PP loss hook: numerics identical to the
+    standard logits path."""
+    import dataclasses
+
+    params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+    mbs = microbatches(jax.random.PRNGKey(1))
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+    specs = llama.param_specs(CFG, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+
+    def pl(cfg):
+        embed_fn, stage_fn, loss_fn = llama.pipeline_hooks(cfg, FP32)
+
+        def f(p, m):
+            return pipeline_loss(
+                p, p["layers"], m,
+                embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, mesh=mesh,
+            )
+
+        return f
+
+    with mesh, shd.use_mesh(mesh):
+        ref, ref_g = jax.jit(jax.value_and_grad(pl(CFG)))(sh_params, mbs)
+        cfg2 = dataclasses.replace(CFG, vocab_chunks=4)
+        got, got_g = jax.jit(jax.value_and_grad(pl(cfg2)))(sh_params, mbs)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(got_g["embed"]["embedding"]),
+        np.asarray(ref_g["embed"]["embedding"]), rtol=5e-4, atol=1e-6)
