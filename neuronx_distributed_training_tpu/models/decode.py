@@ -242,6 +242,8 @@ def prefill_gpt(params, input_ids, cfg, policy, *, max_len=None):
         raise NotImplementedError(
             "cached decode with dropped (capacity-factor) MoE; use dropless"
         )
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        raise NotImplementedError("cached decode with gpt moe_frequency > 1")
     s = input_ids.shape[1]
     max_len = max_len or s
     positions = llama.positions_for(input_ids)

@@ -69,6 +69,7 @@ class GPTConfig:
     activations_checkpoint_granularity: Optional[str] = "selective"
     # MoE (NeuronSwitchMLP equivalent); None -> dense
     moe: Optional[moe_ops.MoEConfig] = None
+    moe_frequency: int = 1  # MoE every Nth layer (reference megatron_gpt_model.py:137)
 
     @property
     def kv_heads(self) -> int:
@@ -96,14 +97,7 @@ class GPTConfig:
         moe_block = m.get("moe") or (
             {"num_experts": m["num_moe_experts"]} if m.get("num_moe_experts") else None
         )
-        if moe_block and int(moe_block.get("frequency", 1) or 1) != 1:
-            # the reference reads moe frequency for the megatron family too
-            # (megatron_gpt_model.py:137); the interleaved layout lives in the
-            # mixtral family here — don't silently train all-MoE
-            raise NotImplementedError(
-                "moe.frequency > 1 for the megatron/gpt family: use "
-                "architecture: mixtral (dense/MoE interleave) instead"
-            )
+        moe_freq = int((moe_block or {}).get("frequency", 1) or 1)
         return cls(
             vocab_size=int(m.get("vocab_size", 50257)),
             hidden_size=int(m.get("hidden_size", 1024)),
@@ -133,6 +127,7 @@ class GPTConfig:
                 "activations_checkpoint_granularity", "selective"
             ),
             moe=moe_ops.MoEConfig.from_config(moe_block) if moe_block else None,
+            moe_frequency=moe_freq,
         )
 
 
@@ -153,7 +148,8 @@ def _apply_norm(cfg: GPTConfig, params, x):
     return norm_ops.apply_layer_norm(params, x, eps=cfg.layernorm_epsilon)
 
 
-def _init_layer(key: jax.Array, cfg: GPTConfig, dtype):
+def _init_layer(key: jax.Array, cfg: GPTConfig, dtype, *, moe_layer=None):
+    """``moe_layer`` overrides the MLP kind (None -> cfg.moe decides)."""
     keys = jax.random.split(key, 6)
     h, d = cfg.hidden_size, cfg.head_size
     nh, nkv = cfg.num_attention_heads, cfg.kv_heads
@@ -172,7 +168,8 @@ def _init_layer(key: jax.Array, cfg: GPTConfig, dtype):
             keys[1], nh * d, h, shard="row", dtype=dtype, stddev=std, use_bias=bias
         )[0],
     }
-    if cfg.moe is not None:
+    is_moe = (cfg.moe is not None) if moe_layer is None else moe_layer
+    if is_moe:
         p["mlp"] = moe_ops.init_moe_params(
             keys[2], h, cfg.ffn_size, cfg.moe, dtype=dtype, stddev=std
         )
@@ -189,6 +186,17 @@ def _init_layer(key: jax.Array, cfg: GPTConfig, dtype):
             )[0],
         }
     return p
+
+
+def num_moe_layers(cfg: GPTConfig) -> int:
+    """Layer ``i`` is MoE iff ``i % moe_frequency == 0`` (reference
+    ``megatron_gpt_model.py:137`` + mixtral's interleave rule)."""
+    f = cfg.moe_frequency
+    if cfg.num_layers % f != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} must divide by moe frequency {f}"
+        )
+    return cfg.num_layers // f
 
 
 def init_params(key: jax.Array, cfg: GPTConfig, policy: DtypePolicy | None = None):
@@ -209,7 +217,26 @@ def init_params(key: jax.Array, cfg: GPTConfig, policy: DtypePolicy | None = Non
             ).astype(dtype)
         }
     layer_keys = jax.random.split(klayers, cfg.num_layers)
-    params["layers"] = jax.vmap(lambda k: _init_layer(k, cfg, dtype))(layer_keys)
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        f, g = cfg.moe_frequency, num_moe_layers(cfg)
+        dense_stack = jax.vmap(
+            lambda k: _init_layer(k, cfg, dtype, moe_layer=False)
+        )(layer_keys)
+        moe_keys = jax.random.split(jax.random.fold_in(klayers, 999), g)
+        moe_mlp = jax.vmap(
+            lambda k: moe_ops.init_moe_params(
+                k, cfg.hidden_size, cfg.ffn_size, cfg.moe,
+                dtype=dtype, stddev=cfg.initializer_range,
+            )
+        )(moe_keys)
+        dense_mlp = jax.tree_util.tree_map(
+            lambda x: x.reshape((g, f) + x.shape[1:])[:, 1:],
+            dense_stack["mlp"],
+        )
+        dense_stack["mlp"] = {"moe": moe_mlp, "dense": dense_mlp}
+        params["layers"] = dense_stack
+    else:
+        params["layers"] = jax.vmap(lambda k: _init_layer(k, cfg, dtype))(layer_keys)
     params["final_norm"] = _norm_init(cfg, dtype)
     if not cfg.share_embeddings_and_output_weights:
         params["lm_head"], _ = linear_ops.init_linear(
@@ -234,18 +261,36 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False):
     if cfg.bias:
         attn["qkv"]["bias"] = P("model")
         attn["o"]["bias"] = P(None)
-    if cfg.moe is not None:
+    dense_mlp = {"up": {"w": P(None, "model")}, "down": {"w": P("model", None)}}
+    if cfg.bias:
+        dense_mlp["up"]["bias"] = P("model")
+        dense_mlp["down"]["bias"] = P(None)
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        mlp = None  # grouped; filled below after stacking
+    elif cfg.moe is not None:
         mlp = moe_ops.moe_param_specs(cfg.moe)
     else:
-        mlp = {"up": {"w": P(None, "model")}, "down": {"w": P("model", None)}}
-        if cfg.bias:
-            mlp["up"]["bias"] = P("model")
-            mlp["down"]["bias"] = P(None)
-    layer = {"input_norm": n, "post_attn_norm": n, "attn": attn, "mlp": mlp}
+        mlp = dense_mlp
+    layer = {"input_norm": n, "post_attn_norm": n, "attn": attn,
+             "mlp": mlp if mlp is not None else dense_mlp}
     lead = "pipe" if pipeline else None
     stacked = jax.tree_util.tree_map(
         lambda s: P(*((lead,) + tuple(s))), layer, is_leaf=lambda x: isinstance(x, P)
     )
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        if pipeline:
+            raise NotImplementedError(
+                "pipeline parallelism with gpt moe_frequency > 1 not supported yet"
+            )
+        moe_specs = jax.tree_util.tree_map(
+            lambda s: P(*((lead,) + tuple(s))), moe_ops.moe_param_specs(cfg.moe),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        grouped_dense = jax.tree_util.tree_map(
+            lambda s: P(*((tuple(s)[0], None) + tuple(s)[1:])), stacked["mlp"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        stacked["mlp"] = {"moe": moe_specs, "dense": grouped_dense}
     specs: dict[str, Any] = {
         "embed": {"embedding": P("model", None)},
         "layers": stacked,
@@ -313,7 +358,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
 
 
 def _mlp_block(cfg, lp, x, policy):
-    if cfg.moe is not None:
+    if cfg.moe is not None and "router" in lp:
         y, aux = moe_ops.moe_block(lp, x, cfg.moe, compute_dtype=policy.compute_dtype)
         aux_loss = moe_ops.weighted_router_loss(
             aux["router_logits"], aux["expert_idx"], cfg.moe
@@ -381,6 +426,10 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
     returns ``(x, aux)``; pass ``stage_aux=True`` (aux is the MoE router loss,
     0 for dense).
     """
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        raise NotImplementedError(
+            "pipeline parallelism with gpt moe_frequency > 1 not supported yet"
+        )
     aspec = shd.act_spec(cfg.sequence_parallel, False)
 
     def embed_fn(params, mb):
@@ -488,30 +537,82 @@ def forward(
         jax.random.split(rng, cfg.num_layers) if rng is not None else None
     )
 
-    def body(carry, inp):
-        x, aux_acc = carry
-        if layer_keys is not None:
-            lp, lkey = inp
-        else:
-            lp, lkey = inp, None
-        x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey,
-                                attention_mask=attention_mask)
-        return (x, aux_acc + aux), None
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        # grouped interleave — mirrors mixtral._grouped_scan but stays
+        # family-local: the bodies genuinely differ (dropout-key threading,
+        # gpt._decoder_layer signature); keep the two in sync on layout
+        # changes. Scan over [L/f]
+        # groups of (1 MoE layer + f-1 dense layers); dropout keys group as
+        # [g, f] so every layer keeps a unique key
+        f, g = cfg.moe_frequency, num_moe_layers(cfg)
+        shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
+        head = jax.tree_util.tree_map(
+            lambda a: a.reshape((g, f) + a.shape[1:])[:, 0], shared)
+        tail = jax.tree_util.tree_map(
+            lambda a: a.reshape((g, f) + a.shape[1:])[:, 1:], shared)
+        moe_xs = {**head, "mlp": layer_stack["mlp"]["moe"]}
+        dense_xs = {**tail, "mlp": layer_stack["mlp"]["dense"]}
+        gkeys = (
+            layer_keys.reshape((g, f) + layer_keys.shape[1:])
+            if layer_keys is not None else None
+        )
+
+        def body(carry, inp):
+            x, aux_acc = carry
+            if gkeys is not None:
+                mxs, dxs, keys_g = inp
+                k0 = keys_g[0]
+            else:
+                mxs, dxs = inp
+                k0 = None
+            x, aux = _decoder_layer(cfg, mxs, x, cos, sin, policy, k0,
+                                    attention_mask=attention_mask)
+
+            def dense_body(carry2, dinp):
+                x2, acc2 = carry2
+                if gkeys is not None:
+                    dlp, dk = dinp
+                else:
+                    dlp, dk = dinp, None
+                x2, a2 = _decoder_layer(cfg, dlp, x2, cos, sin, policy, dk,
+                                        attention_mask=attention_mask)
+                return (x2, acc2 + a2), None
+
+            dxs_in = (dxs, keys_g[1:]) if gkeys is not None else dxs
+            (x, aux_acc2), _ = jax.lax.scan(
+                dense_body, (x, jnp.zeros((), jnp.float32)), dxs_in)
+            return (x, aux_acc + aux + aux_acc2), None
+
+        xs = ((moe_xs, dense_xs, gkeys) if gkeys is not None
+              else (moe_xs, dense_xs))
+    else:
+
+        def body(carry, inp):
+            x, aux_acc = carry
+            if layer_keys is not None:
+                lp, lkey = inp
+            else:
+                lp, lkey = inp, None
+            x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey,
+                                    attention_mask=attention_mask)
+            return (x, aux_acc + aux), None
+
+        xs = (layer_stack, layer_keys) if layer_keys is not None else layer_stack
 
     from neuronx_distributed_training_tpu.models.llama import _remat_policy
 
     remat = _remat_policy(cfg.activations_checkpoint_granularity)
     if remat is not None:
         body = jax.checkpoint(body, policy=remat, prevent_cse=False)
-    xs = (layer_stack, layer_keys) if layer_keys is not None else layer_stack
     (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
     hidden = _apply_norm(cfg, params["final_norm"], x)
     logits = _logits_from_hidden(params, hidden, cfg, policy)
 
     aux: dict[str, Any] = {}
     if cfg.moe is not None:
-        # already coefficient-weighted (weighted_router_loss)
-        aux["router_aux_loss"] = aux_sum / cfg.num_layers
+        # already coefficient-weighted (weighted_router_loss); averaged over
+        # the layers that HAVE routers
+        aux["router_aux_loss"] = aux_sum / num_moe_layers(cfg)
     if return_logits:
         aux["logits"] = logits
     labels = batch.get("labels")

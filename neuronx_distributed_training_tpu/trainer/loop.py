@@ -193,10 +193,15 @@ class Trainer:
                     raise NotImplementedError(
                         "interleaved pipeline (vp > 1) with moe_frequency > 1"
                     )
-                # pipe slices whole (MoE + dense) groups
+                # pipe slices whole (MoE + dense) groups; num_moe_layers is
+                # family-specific (mixtral wraps a llama config, gpt is flat)
+                from neuronx_distributed_training_tpu.models import gpt as _gpt
                 from neuronx_distributed_training_tpu.models import mixtral as _mx
 
-                groups = _mx.num_moe_layers(model_cfg)
+                if isinstance(model_cfg, _gpt.GPTConfig):
+                    groups = _gpt.num_moe_layers(model_cfg)
+                else:
+                    groups = _mx.num_moe_layers(model_cfg)
                 if groups % pp != 0:
                     raise ValueError(
                         f"num_layers {model_cfg.num_layers} / moe frequency "
