@@ -39,11 +39,11 @@ def emit(payload: dict) -> None:
 # bench_results/): carried in the diagnostic JSON so a transient tunnel/backend
 # outage at bench time doesn't erase the evidence of what the code measured.
 LAST_MEASURED = {
-    "date": "2026-07-29",
+    "date": "2026-07-30",
     "device": "TPU v5 lite",
-    "mfu_mixed_precision": 63.69,
-    "mfu_bf16": 68.22,
-    "tokens_per_sec_per_chip_bf16": 28827.6,
+    "mfu_mixed_precision": 63.98,
+    "mfu_bf16": 68.35,
+    "tokens_per_sec_per_chip_bf16": 28884.0,
     "seq_len": 8192,
     "note": "see bench_results/ for the full JSON lines",
 }
