@@ -159,11 +159,15 @@ def decode_step(params, cache: dict, tokens: jax.Array, pos: jax.Array,
 
 
 def prefill_mixtral(params, input_ids, cfg, policy, *, max_len=None):
-    """Mixtral prefill: llama structure with the MoE MLP slot."""
+    """Mixtral prefill: llama structure with the MoE MLP slot.
+
+    ``moe_frequency > 1``: the grouped [G]-scan runs (1 MoE + f-1 dense
+    llama) layers per step and re-flattens the captured KV to the flat
+    ``[L, ...]`` cache layout, so ``decode_step_mixtral`` sees one uniform
+    cache regardless of interleave.
+    """
     from neuronx_distributed_training_tpu.models import mixtral
 
-    if cfg.moe_frequency != 1:
-        raise NotImplementedError("cached decode with moe_frequency > 1")
     if not cfg.moe.dropless:
         # capacity-factor routing computes capacity over the CURRENT batch:
         # a b-token decode step would contend for a tiny capacity and zero
@@ -181,20 +185,60 @@ def prefill_mixtral(params, input_ids, cfg, policy, *, max_len=None):
     x = shd.constrain(x, aspec)
     cos, sin = llama._rope_for(input_ids, lc)
     layer_stack = policy.cast_to_compute(params["layers"])
+    pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
 
-    def body(x, lp):
-        x, _aux, (k, v) = mixtral._decoder_layer(
-            lp, x, cos, sin, cfg, policy, return_kv=True
-        )
-        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
-        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+    if cfg.moe_frequency > 1:
 
-    x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
+        def gbody(x, gp):
+            x, _aux, (k0, v0) = mixtral._decoder_layer(
+                gp["moe"], x, cos, sin, cfg, policy, return_kv=True
+            )
+
+            def dense_body(x2, dlp):
+                x2, (k, v) = llama._decoder_layer(
+                    dlp, x2, cos, sin, lc, policy, return_kv=True
+                )
+                return x2, (k, v)
+
+            x, (kd, vd) = jax.lax.scan(dense_body, x, gp["dense"])
+            k = jnp.concatenate([k0[None], kd], axis=0)  # [f, b, s, kvh, d]
+            v = jnp.concatenate([v0[None], vd], axis=0)
+            return x, (jnp.pad(k, [(0, 0)] + pad), jnp.pad(v, [(0, 0)] + pad))
+
+        x, (ck, cv) = jax.lax.scan(gbody, x, mixtral._group_xs(cfg, layer_stack))
+        # [G, f, ...] -> flat [L, ...] (groups are contiguous layer runs)
+        ck = ck.reshape((-1,) + ck.shape[2:])
+        cv = cv.reshape((-1,) + cv.shape[2:])
+    else:
+
+        def body(x, lp):
+            x, _aux, (k, v) = mixtral._decoder_layer(
+                lp, x, cos, sin, cfg, policy, return_kv=True
+            )
+            return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
     h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
     return h, {"k": ck, "v": cv}
 
 
+def _llama_attn_step(lp, x, ck, cv, pos, lc, policy, cos, sin):
+    """Shared cached-attention sublayer for llama-structured decode bodies."""
+    residual = x
+    hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
+    q, k, v = _qkv(lp["attn"], hidden, lc)
+    q = rope_ops.apply_rope(q, cos, sin)
+    k = rope_ops.apply_rope(k, cos, sin)
+    out, ck, cv = _cached_attn(
+        q, k, v, ck, cv, pos, sliding_window=lc.sliding_window,
+        softmax_dtype=policy.softmax_dtype,
+    )
+    x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
+    return x, ck, cv
+
+
 def decode_step_mixtral(params, cache, tokens, pos, cfg, policy):
+    from neuronx_distributed_training_tpu.models import mixtral
     from neuronx_distributed_training_tpu.ops import moe as moe_ops
 
     lc = cfg.llama
@@ -208,27 +252,52 @@ def decode_step_mixtral(params, cache, tokens, pos, cfg, policy):
     cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
     layer_stack = policy.cast_to_compute(params["layers"])
 
-    def body(x, inp):
-        lp, ck, cv = inp
-        residual = x
-        hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
-        q, k, v = _qkv(lp["attn"], hidden, lc)
-        q = rope_ops.apply_rope(q, cos, sin)
-        k = rope_ops.apply_rope(k, cos, sin)
-        out, ck, cv = _cached_attn(
-            q, k, v, ck, cv, pos, sliding_window=lc.sliding_window,
-            softmax_dtype=policy.softmax_dtype,
-        )
-        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
+    def moe_mlp(lp, x):
         residual = x
         hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
         hidden, _aux = moe_ops.moe_block(
             lp["mlp"], hidden, cfg.moe, compute_dtype=policy.compute_dtype
         )
-        x = residual + hidden
-        return x, (ck, cv)
+        return residual + hidden
 
-    x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
+    def dense_mlp(lp, x):
+        residual = x
+        hidden = norm_ops.apply_rms_norm(lp["post_attn_norm"], x, eps=lc.rms_norm_eps)
+        return residual + llama._mlp_block(lp["mlp"], hidden)
+
+    if cfg.moe_frequency > 1:
+        f = cfg.moe_frequency
+        gk = cache["k"].reshape((-1, f) + cache["k"].shape[1:])
+        gv = cache["v"].reshape((-1, f) + cache["v"].shape[1:])
+
+        def gbody(x, inp):
+            gp, ck, cv = inp  # ck/cv [f, b, max_len, kvh, d]
+            x, ck0, cv0 = _llama_attn_step(
+                gp["moe"], x, ck[0], cv[0], pos, lc, policy, cos, sin)
+            x = moe_mlp(gp["moe"], x)
+
+            def dense_body(x2, dinp):
+                dlp, dk, dv = dinp
+                x2, dk, dv = _llama_attn_step(
+                    dlp, x2, dk, dv, pos, lc, policy, cos, sin)
+                return dense_mlp(dlp, x2), (dk, dv)
+
+            x, (ckd, cvd) = jax.lax.scan(dense_body, x, (gp["dense"], ck[1:], cv[1:]))
+            return x, (jnp.concatenate([ck0[None], ckd], axis=0),
+                       jnp.concatenate([cv0[None], cvd], axis=0))
+
+        x, (ck, cv) = jax.lax.scan(
+            gbody, x, (mixtral._group_xs(cfg, layer_stack), gk, gv))
+        ck = ck.reshape((-1,) + ck.shape[2:])
+        cv = cv.reshape((-1,) + cv.shape[2:])
+    else:
+
+        def body(x, inp):
+            lp, ck, cv = inp
+            x, ck, cv = _llama_attn_step(lp, x, ck, cv, pos, lc, policy, cos, sin)
+            return moe_mlp(lp, x), (ck, cv)
+
+        x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
     h = norm_ops.apply_rms_norm(params["final_norm"], x, eps=lc.rms_norm_eps)
     logits = llama.logits_fn(params, h, lc, policy)
     return logits[:, 0], {"k": ck, "v": cv}
@@ -242,8 +311,6 @@ def prefill_gpt(params, input_ids, cfg, policy, *, max_len=None):
         raise NotImplementedError(
             "cached decode with dropped (capacity-factor) MoE; use dropless"
         )
-    if cfg.moe is not None and cfg.moe_frequency > 1:
-        raise NotImplementedError("cached decode with gpt moe_frequency > 1")
     s = input_ids.shape[1]
     max_len = max_len or s
     positions = llama.positions_for(input_ids)
@@ -256,15 +323,38 @@ def prefill_gpt(params, input_ids, cfg, policy, *, max_len=None):
         ).astype(x.dtype)
     cos, sin = gpt._rope_for(cfg, input_ids, positions=positions)
     layer_stack = policy.cast_to_compute(params["layers"])
+    pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
 
-    def body(x, lp):
-        x, _aux, (k, v) = gpt._decoder_layer(
-            cfg, lp, x, cos, sin, policy, None, return_kv=True
-        )
-        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
-        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        # grouped [G]-scan; KV re-flattened to [L, ...] (see prefill_mixtral)
+        def gbody(x, gp):
+            x, _aux, (k0, v0) = gpt._decoder_layer(
+                cfg, gp["moe"], x, cos, sin, policy, None, return_kv=True
+            )
 
-    x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
+            def dense_body(x2, dlp):
+                x2, _a, (k, v) = gpt._decoder_layer(
+                    cfg, dlp, x2, cos, sin, policy, None, return_kv=True
+                )
+                return x2, (k, v)
+
+            x, (kd, vd) = jax.lax.scan(dense_body, x, gp["dense"])
+            k = jnp.concatenate([k0[None], kd], axis=0)
+            v = jnp.concatenate([v0[None], vd], axis=0)
+            return x, (jnp.pad(k, [(0, 0)] + pad), jnp.pad(v, [(0, 0)] + pad))
+
+        x, (ck, cv) = jax.lax.scan(gbody, x, gpt._group_xs(cfg, layer_stack))
+        ck = ck.reshape((-1,) + ck.shape[2:])
+        cv = cv.reshape((-1,) + cv.shape[2:])
+    else:
+
+        def body(x, lp):
+            x, _aux, (k, v) = gpt._decoder_layer(
+                cfg, lp, x, cos, sin, policy, None, return_kv=True
+            )
+            return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
     h = gpt._apply_norm(cfg, params["final_norm"], x)
     return h, {"k": ck, "v": cv}
 
@@ -288,8 +378,7 @@ def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
         cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
     layer_stack = policy.cast_to_compute(params["layers"])
 
-    def body(x, inp):
-        lp, ck, cv = inp
+    def layer_step(lp, x, ck, cv):
         residual = x
         hidden = gpt._apply_norm(cfg, lp["input_norm"], x)
         qkv = linear_ops.apply_linear(lp["attn"]["qkv"], hidden)
@@ -315,10 +404,39 @@ def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
         residual = x
         hidden = gpt._apply_norm(cfg, lp["post_attn_norm"], x)
         hidden, _aux = gpt._mlp_block(cfg, lp["mlp"], hidden, policy)
-        x = residual + hidden
-        return x, (ck, cv)
+        return residual + hidden, ck, cv
 
-    x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
+    if cfg.moe is not None and cfg.moe_frequency > 1:
+        f = cfg.moe_frequency
+        gk = cache["k"].reshape((-1, f) + cache["k"].shape[1:])
+        gv = cache["v"].reshape((-1, f) + cache["v"].shape[1:])
+
+        def gbody(x, inp):
+            gp, ck, cv = inp
+            x, ck0, cv0 = layer_step(gp["moe"], x, ck[0], cv[0])
+
+            def dense_body(x2, dinp):
+                dlp, dk, dv = dinp
+                x2, dk, dv = layer_step(dlp, x2, dk, dv)
+                return x2, (dk, dv)
+
+            x, (ckd, cvd) = jax.lax.scan(
+                dense_body, x, (gp["dense"], ck[1:], cv[1:]))
+            return x, (jnp.concatenate([ck0[None], ckd], axis=0),
+                       jnp.concatenate([cv0[None], cvd], axis=0))
+
+        x, (ck, cv) = jax.lax.scan(
+            gbody, x, (gpt._group_xs(cfg, layer_stack), gk, gv))
+        ck = ck.reshape((-1,) + ck.shape[2:])
+        cv = cv.reshape((-1,) + cv.shape[2:])
+    else:
+
+        def body(x, inp):
+            lp, ck, cv = inp
+            x, ck, cv = layer_step(lp, x, ck, cv)
+            return x, (ck, cv)
+
+        x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
     h = gpt._apply_norm(cfg, params["final_norm"], x)
     logits = gpt._logits_from_hidden(params, h, cfg, policy)
     return logits[:, 0], {"k": ck, "v": cv}

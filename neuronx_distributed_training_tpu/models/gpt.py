@@ -278,10 +278,9 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False):
         lambda s: P(*((lead,) + tuple(s))), layer, is_leaf=lambda x: isinstance(x, P)
     )
     if cfg.moe is not None and cfg.moe_frequency > 1:
-        if pipeline:
-            raise NotImplementedError(
-                "pipeline parallelism with gpt moe_frequency > 1 not supported yet"
-            )
+        # grouped layout: moe leads [G] and dense [G, f-1]; under pipeline
+        # both lead with "pipe" (pp slices whole MoE+dense groups, matching
+        # the flat [L] attn/norm slices since L/pp == (G/pp)*f)
         moe_specs = jax.tree_util.tree_map(
             lambda s: P(*((lead,) + tuple(s))), moe_ops.moe_param_specs(cfg.moe),
             is_leaf=lambda x: isinstance(x, P),
@@ -405,6 +404,63 @@ def _rope_for(cfg: GPTConfig, input_ids: jax.Array, positions=None):
     return rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
 
 
+def _group_xs(cfg: GPTConfig, layer_stack):
+    """Grouped scan inputs (see ``ops.moe.group_interleaved_stack``)."""
+    return moe_ops.group_interleaved_stack(cfg.moe_frequency, layer_stack)
+
+
+def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
+                  layer_keys=None, attention_mask=None):
+    """(xs, body) for the dense/MoE interleave scan over [G] groups.
+
+    Shared by ``forward`` and the pipeline ``stage_fn`` (mirrors
+    ``mixtral._grouped_scan``; the body differs by GPT's dropout-key
+    threading).  Each group runs one MoE layer then ``f-1`` dense layers;
+    groups are contiguous runs of ``f`` layers, so any contiguous slice of
+    the flat attn/norm stack aligns with the matching moe/dense group slices
+    — which is what makes the layout pipeline-sliceable.  Dropout keys group
+    as ``[g, f]`` so every layer keeps a unique key.
+    """
+    f = cfg.moe_frequency
+    g = jax.tree_util.tree_leaves(layer_stack["mlp"]["moe"])[0].shape[0]
+    grouped = _group_xs(cfg, layer_stack)
+    moe_xs, dense_xs = grouped["moe"], grouped["dense"]
+    gkeys = (
+        layer_keys.reshape((g, f) + layer_keys.shape[1:])
+        if layer_keys is not None else None
+    )
+
+    def body(carry, inp):
+        x, aux_acc = carry
+        if gkeys is not None:
+            mxs, dxs, keys_g = inp
+            k0 = keys_g[0]
+        else:
+            mxs, dxs = inp
+            k0 = None
+        x, aux = _decoder_layer(cfg, mxs, x, cos, sin, policy, k0,
+                                attention_mask=attention_mask)
+
+        def dense_body(carry2, dinp):
+            x2, acc2 = carry2
+            if gkeys is not None:
+                dlp, dk = dinp
+            else:
+                dlp, dk = dinp, None
+            x2, a2 = _decoder_layer(cfg, dlp, x2, cos, sin, policy, dk,
+                                    attention_mask=attention_mask)
+            return (x2, acc2 + a2), None
+
+        dxs_in = (dxs, keys_g[1:]) if gkeys is not None else dxs
+        (x, aux_acc2), _ = jax.lax.scan(
+            dense_body, (x, jnp.zeros((), jnp.float32)), dxs_in)
+        return (x, aux_acc + aux + aux_acc2), None
+
+    xs = ((moe_xs, dense_xs, gkeys) if gkeys is not None
+          else (moe_xs, dense_xs))
+    return xs, body
+
+
 def _logits_from_hidden(params, hidden, cfg: GPTConfig, policy: DtypePolicy):
     if cfg.share_embeddings_and_output_weights:
         w = params["embed"]["embedding"].astype(policy.compute_dtype)
@@ -426,10 +482,6 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
     returns ``(x, aux)``; pass ``stage_aux=True`` (aux is the MoE router loss,
     0 for dense).
     """
-    if cfg.moe is not None and cfg.moe_frequency > 1:
-        raise NotImplementedError(
-            "pipeline parallelism with gpt moe_frequency > 1 not supported yet"
-        )
     aspec = shd.act_spec(cfg.sequence_parallel, False)
 
     def embed_fn(params, mb):
@@ -451,8 +503,17 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
     def stage_fn(local_layers, x, mb):
         cos, sin = _rope_for(cfg, mb["input_ids"])
         local_layers = policy.cast_to_compute(local_layers)
-        n_local = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
+        grouped = cfg.moe is not None and cfg.moe_frequency > 1
+        if grouped:
+            # local layer count = local groups x f (flat attn/norm slices)
+            n_local = (
+                jax.tree_util.tree_leaves(local_layers["mlp"]["moe"])[0].shape[0]
+                * cfg.moe_frequency
+            )
+        else:
+            n_local = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
         rng = mb.get("_rng")
+        layer_keys = None
         if rng is not None and cfg.hidden_dropout > 0.0:
             try:
                 rank = jax.lax.axis_index("pipe")
@@ -462,6 +523,11 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
                 jax.random.fold_in(rng, rank), mb.get("_chunk", 0)
             )
             layer_keys = jax.random.split(stage_rng, n_local)
+        if grouped:
+            # grouped interleave on the LOCAL slice (see _grouped_scan)
+            xs, body = _grouped_scan(cfg, local_layers, cos, sin, policy,
+                                     layer_keys=layer_keys)
+        elif layer_keys is not None:
 
             def body(carry, inp):
                 x, aux_acc = carry
@@ -538,53 +604,10 @@ def forward(
     )
 
     if cfg.moe is not None and cfg.moe_frequency > 1:
-        # grouped interleave — mirrors mixtral._grouped_scan but stays
-        # family-local: the bodies genuinely differ (dropout-key threading,
-        # gpt._decoder_layer signature); keep the two in sync on layout
-        # changes. Scan over [L/f]
-        # groups of (1 MoE layer + f-1 dense layers); dropout keys group as
-        # [g, f] so every layer keeps a unique key
-        f, g = cfg.moe_frequency, num_moe_layers(cfg)
-        shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
-        head = jax.tree_util.tree_map(
-            lambda a: a.reshape((g, f) + a.shape[1:])[:, 0], shared)
-        tail = jax.tree_util.tree_map(
-            lambda a: a.reshape((g, f) + a.shape[1:])[:, 1:], shared)
-        moe_xs = {**head, "mlp": layer_stack["mlp"]["moe"]}
-        dense_xs = {**tail, "mlp": layer_stack["mlp"]["dense"]}
-        gkeys = (
-            layer_keys.reshape((g, f) + layer_keys.shape[1:])
-            if layer_keys is not None else None
-        )
-
-        def body(carry, inp):
-            x, aux_acc = carry
-            if gkeys is not None:
-                mxs, dxs, keys_g = inp
-                k0 = keys_g[0]
-            else:
-                mxs, dxs = inp
-                k0 = None
-            x, aux = _decoder_layer(cfg, mxs, x, cos, sin, policy, k0,
-                                    attention_mask=attention_mask)
-
-            def dense_body(carry2, dinp):
-                x2, acc2 = carry2
-                if gkeys is not None:
-                    dlp, dk = dinp
-                else:
-                    dlp, dk = dinp, None
-                x2, a2 = _decoder_layer(cfg, dlp, x2, cos, sin, policy, dk,
-                                        attention_mask=attention_mask)
-                return (x2, acc2 + a2), None
-
-            dxs_in = (dxs, keys_g[1:]) if gkeys is not None else dxs
-            (x, aux_acc2), _ = jax.lax.scan(
-                dense_body, (x, jnp.zeros((), jnp.float32)), dxs_in)
-            return (x, aux_acc + aux + aux_acc2), None
-
-        xs = ((moe_xs, dense_xs, gkeys) if gkeys is not None
-              else (moe_xs, dense_xs))
+        # grouped interleave: scan over [L/f] groups of (MoE + f-1 dense)
+        xs, body = _grouped_scan(cfg, layer_stack, cos, sin, policy,
+                                 layer_keys=layer_keys,
+                                 attention_mask=attention_mask)
     else:
 
         def body(carry, inp):

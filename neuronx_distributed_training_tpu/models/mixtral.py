@@ -146,25 +146,20 @@ def param_specs(cfg: MixtralConfig, *, pipeline: bool = False):
     return specs
 
 
+def _group_xs(cfg: MixtralConfig, layer_stack):
+    """Grouped scan inputs (see ``ops.moe.group_interleaved_stack``)."""
+    return moe_ops.group_interleaved_stack(cfg.moe_frequency, layer_stack)
+
+
 def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
                   attention_mask=None):
     """(xs, body) for the dense/MoE interleave scan over [G] groups.
 
     Shared by ``forward`` and the pipeline ``stage_fn``: each group runs one
-    MoE layer then ``f-1`` dense llama layers; groups are contiguous runs of
-    ``f`` layers, so any contiguous slice of the flat attn/norm stack aligns
-    with the matching moe/dense group slices.
+    MoE layer then ``f-1`` dense llama layers (see ``_group_xs``).
     """
-    f = cfg.moe_frequency
-    gc = jax.tree_util.tree_leaves(layer_stack["mlp"]["moe"])[0].shape[0]
     lc = cfg.llama
-    shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
-    head = jax.tree_util.tree_map(
-        lambda a: a.reshape((gc, f) + a.shape[1:])[:, 0], shared)
-    tail = jax.tree_util.tree_map(
-        lambda a: a.reshape((gc, f) + a.shape[1:])[:, 1:], shared)
-    xs = {"moe": {**head, "mlp": layer_stack["mlp"]["moe"]},
-          "dense": {**tail, "mlp": layer_stack["mlp"]["dense"]}}
+    xs = _group_xs(cfg, layer_stack)
 
     def body(carry, gp):
         x, aux_acc = carry

@@ -99,6 +99,28 @@ def moe_param_specs(cfg: MoEConfig):
     }
 
 
+def group_interleaved_stack(moe_frequency: int, layer_stack):
+    """Split a grouped dense/MoE layer stack into scan inputs.
+
+    Layout shared by the mixtral and gpt families for ``moe_frequency > 1``:
+    attn/norm leaves are flat ``[L, ...]``, ``mlp`` is ``{"moe": [G, ...],
+    "dense": [G, f-1, ...]}`` with ``G = L / f``.  Returns ``{"moe": [G, ...],
+    "dense": [G, f-1, ...]}`` scan inputs — groups are contiguous runs of
+    ``f`` layers (MoE first), so any contiguous slice of the flat attn/norm
+    stack aligns with the matching moe/dense group slices, which is what makes
+    the layout pipeline-sliceable.
+    """
+    f = moe_frequency
+    g = jax.tree_util.tree_leaves(layer_stack["mlp"]["moe"])[0].shape[0]
+    shared = {k: v for k, v in layer_stack.items() if k != "mlp"}
+    head = jax.tree_util.tree_map(
+        lambda a: a.reshape((g, f) + a.shape[1:])[:, 0], shared)
+    tail = jax.tree_util.tree_map(
+        lambda a: a.reshape((g, f) + a.shape[1:])[:, 1:], shared)
+    return {"moe": {**head, "mlp": layer_stack["mlp"]["moe"]},
+            "dense": {**tail, "mlp": layer_stack["mlp"]["dense"]}}
+
+
 # ---------------------------------------------------------------------------
 # routing
 # ---------------------------------------------------------------------------

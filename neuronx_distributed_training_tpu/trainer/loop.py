@@ -215,29 +215,59 @@ class Trainer:
             if alignment in ("dpo", "orpo"):
                 # preference losses pipeline via the concatenated forward
                 # (reference base_dpo.py:68-88 runs chosen+rejected through
-                # NxDPPModel as one doubled batch)
-                if not isinstance(model_cfg, llama.LlamaConfig):
-                    raise NotImplementedError(
-                        f"{alignment.upper()} + pipeline parallelism is wired "
-                        f"for the llama family only"
-                    )
+                # NxDPPModel as one doubled batch); every family pipelines —
+                # the head_fn (final norm + lm head) is the only per-family bit
                 from neuronx_distributed_training_tpu.alignment.dpo import (
                     preference_pipeline_hooks,
                 )
                 from neuronx_distributed_training_tpu.ops import norm as norm_ops
 
-                base_embed, base_stage, _ = llama.pipeline_hooks(model_cfg, policy)
-
-                def head_fn(p, y):
-                    h = norm_ops.apply_rms_norm(
-                        p["final_norm"], y, eps=model_cfg.rms_norm_eps
+                if isinstance(model_cfg, llama.LlamaConfig):
+                    base_embed, base_stage, _ = llama.pipeline_hooks(
+                        model_cfg, policy
                     )
-                    return llama.logits_fn(p, h, model_cfg, policy)
+                    hook_opts: dict = {}
 
+                    def head_fn(p, y):
+                        h = norm_ops.apply_rms_norm(
+                            p["final_norm"], y, eps=model_cfg.rms_norm_eps
+                        )
+                        return llama.logits_fn(p, h, model_cfg, policy)
+
+                else:
+                    (base_embed, base_stage, _), hook_opts = pipeline_hooks_for(
+                        cfg, model_cfg, policy, shift_labels=shift_labels
+                    )
+                    from neuronx_distributed_training_tpu.models import (
+                        gpt as _gptm,
+                        mixtral as _mxm,
+                    )
+
+                    if isinstance(model_cfg, _mxm.MixtralConfig):
+                        _lc = model_cfg.llama
+
+                        def head_fn(p, y):
+                            h = norm_ops.apply_rms_norm(
+                                p["final_norm"], y, eps=_lc.rms_norm_eps
+                            )
+                            return llama.logits_fn(p, h, _lc, policy)
+
+                    else:
+
+                        def head_fn(p, y):
+                            h = _gptm._apply_norm(model_cfg, p["final_norm"], y)
+                            return _gptm._logits_from_hidden(
+                                p, h, model_cfg, policy
+                            )
+
+                    # reference parity: the HF models add the router aux loss
+                    # only when ``labels`` is passed; the DPO/ORPO path
+                    # computes logits without labels, so no aux term here
+                    # (stage_aux stays — MoE stages return (x, aux) tuples)
+                    hook_opts = dict(hook_opts, aux_inv_layers=0.0)
                 embed_fn, stage_fn, stage_loss_fn = preference_pipeline_hooks(
                     base_embed, base_stage, head_fn, mode=alignment, beta=beta
                 )
-                hook_opts: dict = {}
             else:
                 (embed_fn, stage_fn, stage_loss_fn), hook_opts = pipeline_hooks_for(
                     cfg, model_cfg, policy, shift_labels=shift_labels
@@ -711,8 +741,10 @@ def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
     if isinstance(model_cfg, gpt.GPTConfig):
         opts = {
             "stage_aux": True,
+            # normalized over the layers that HAVE routers (moe_frequency)
             "aux_inv_layers": (
-                1.0 / model_cfg.num_layers if model_cfg.moe is not None else 0.0
+                1.0 / gpt.num_moe_layers(model_cfg)
+                if model_cfg.moe is not None else 0.0
             ),
             "needs_rng": (
                 model_cfg.hidden_dropout > 0.0 or model_cfg.embedding_dropout > 0.0

@@ -140,3 +140,55 @@ class TestFamilyDecode:
         out = decode.generate_cached(params, cfg, FP32, ids, lens,
                                      max_new_tokens=8, eos_id=96, pad_id=0)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestInterleavedDecode:
+    """moe_frequency > 1: grouped prefill/decode, flat [L] cache layout."""
+
+    def test_mixtral_interleaved_greedy_parity(self):
+        import dataclasses
+
+        from neuronx_distributed_training_tpu.models import mixtral
+        from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+        cfg = mixtral.MixtralConfig(
+            llama=dataclasses.replace(CFG, num_layers=4),
+            moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+            moe_frequency=2,
+        )
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        prompts = [[5, 6, 7, 8], [10, 11]]
+        ids, lens = pad_prompts(prompts, pad_id=0)
+
+        def logits_of(p, buf):
+            return mixtral.forward(p, {"input_ids": buf}, cfg, FP32)[0]
+
+        ref = generate(params, ids, lens, logits_of, max_new_tokens=8,
+                       eos_id=96, pad_id=0)
+        out = decode.generate_cached(params, cfg, FP32, ids, lens,
+                                     max_new_tokens=8, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_gpt_interleaved_greedy_parity(self):
+        from neuronx_distributed_training_tpu.models import gpt
+        from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+        cfg = gpt.GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=4, num_attention_heads=4,
+            num_query_groups=2, max_position_embeddings=64,
+            activations_checkpoint_granularity=None,
+            moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+            moe_frequency=2,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        prompts = [[5, 6, 7, 8, 9], [10, 11, 12]]
+        ids, lens = pad_prompts(prompts, pad_id=0)
+
+        def logits_of(p, buf):
+            return gpt.forward(p, {"input_ids": buf}, cfg, FP32)[0]
+
+        ref = generate(params, ids, lens, logits_of, max_new_tokens=8,
+                       eos_id=96, pad_id=0)
+        out = decode.generate_cached(params, cfg, FP32, ids, lens,
+                                     max_new_tokens=8, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -223,9 +223,9 @@ class TestGPTMoEFrequency:
         with pytest.raises(ValueError, match="frequency"):
             gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
 
-    def test_pipeline_guard_is_clear(self, devices8):
-        """gpt + moe_frequency>1 + pp must raise the intended guard, not an
-        AttributeError from the mixtral helper."""
+    def test_interleave_under_pp_trains(self, devices8):
+        """gpt + moe_frequency>1 + pp=2 now trains end-to-end (grouped stage
+        slicing); one fit() step produces a finite loss."""
         from neuronx_distributed_training_tpu.config.loader import load_config
         from neuronx_distributed_training_tpu.trainer.loop import Trainer
 
@@ -244,5 +244,6 @@ class TestGPTMoEFrequency:
                       "optim": {"lr": 1e-3}},
             "precision": {"type": "mixed_precision"},
         })
-        with pytest.raises(NotImplementedError, match="gpt moe_frequency"):
-            Trainer.from_config(cfg, enable_checkpointing=False)
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        m = t.fit()
+        assert np.isfinite(m["loss"])

@@ -491,3 +491,103 @@ def test_chunked_ce_pp2_matches(devices8):
     np.testing.assert_allclose(
         np.asarray(got_g["embed"]["embedding"]),
         np.asarray(ref_g["embed"]["embedding"]), rtol=5e-4, atol=1e-6)
+
+
+def test_gpt_interleaved_pp2_matches_reference(devices8):
+    """GPT moe_frequency=2 under pp=2: grouped stage slicing (whole MoE+dense
+    groups per rank) matches the per-microbatch unpipelined forward — the GPT
+    mirror of the mixtral interleave test."""
+    from neuronx_distributed_training_tpu.models import gpt
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+    cfg = gpt.GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=8, num_attention_heads=4,
+        max_position_embeddings=32, normalization="rmsnorm", bias=False,
+        activation="swiglu", ffn_hidden_size=64,
+        activations_checkpoint_granularity=None,
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True,
+                              router_aux_loss_coef=0.02),
+        moe_frequency=2,
+    )
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+    mbs = microbatches(jax.random.PRNGKey(1))
+    nm = mbs["input_ids"].shape[0]
+
+    def ref(p, m):
+        def body(acc, mb):
+            loss, _ = gpt.forward(p, mb, cfg, FP32)
+            return acc + loss, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+        return total / nm
+
+    ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+    embed_fn, stage_fn, loss_fn = gpt.pipeline_hooks(cfg, FP32)
+
+    def pl(p, m):
+        return pipeline_loss(
+            p, p["layers"], m,
+            embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+            mesh=mesh, stage_aux=True,
+            aux_scale=1.0 / (nm * gpt.num_moe_layers(cfg)),
+        )
+
+    specs = gpt.param_specs(cfg, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    with mesh, shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    for path in (("layers", "mlp", "moe", "router", "w"),
+                 ("layers", "mlp", "dense", "up", "w"),
+                 ("layers", "attn", "qkv", "w"),
+                 ("embed", "embedding")):
+        g, rg = grads, ref_g
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {path}",
+        )
+
+
+def test_gpt_interleaved_pp2_dropout_runs(devices8):
+    """Grouped dropout-key threading ([g, f] per stage) under pp=2."""
+    from neuronx_distributed_training_tpu.models import gpt
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+    cfg = gpt.GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=8, num_attention_heads=4,
+        max_position_embeddings=32, hidden_dropout=0.1,
+        activations_checkpoint_granularity=None,
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+        moe_frequency=2,
+    )
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+    mbs = dict(microbatches(jax.random.PRNGKey(1)))
+    mbs["_rng"] = jax.random.split(jax.random.PRNGKey(7), 4)
+
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+    embed_fn, stage_fn, loss_fn = gpt.pipeline_hooks(cfg, FP32)
+
+    def pl(p, m):
+        return pipeline_loss(
+            p, p["layers"], m,
+            embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+            mesh=mesh, stage_aux=True,
+            aux_scale=1.0 / (4 * gpt.num_moe_layers(cfg)),
+        )
+
+    specs = gpt.param_specs(cfg, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    with mesh, shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+    assert np.isfinite(float(loss))
+    assert np.all(np.isfinite(np.asarray(grads["layers"]["mlp"]["moe"]["router"]["w"])))

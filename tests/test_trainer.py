@@ -348,3 +348,44 @@ def test_mixtral_pipeline_trainer(tmp_path, devices8):
         t = Trainer.from_config(cfg, enable_checkpointing=False)
         m = t.fit()
         assert np.isfinite(m["loss"]), f"frequency={freq}"
+
+
+def test_preference_pp_mixtral_and_gpt(tmp_path, devices8):
+    """DPO/ORPO under pipeline parallelism for the non-llama families:
+    concatenated forward through MoE stages ((x, aux) tuples) with the
+    per-family head_fn."""
+    from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    records = [{"prompt": f"q{i}", "chosen": "yes good", "rejected": "no"}
+               for i in range(16)]
+
+    # Mixtral + DPO + pp=2
+    cfg = tiny_cfg(tmp_path, max_steps=1)
+    cfg["model_alignment_strategy"] = "dpo"
+    cfg["model"]["architecture"] = "mixtral"
+    cfg["model"]["moe"] = {"num_experts": 2, "top_k": 1, "dropless": True}
+    cfg["model"]["num_layers"] = 4
+    cfg["distributed_strategy"] = {"pipeline_model_parallel_size": 2}
+    dm = DPODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
+    t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+    m = t.fit()
+    assert np.isfinite(m["loss"])
+    assert "reference_chosen_logps" in dm.arrays
+
+    # Megatron-GPT + ORPO + pp=2
+    cfg2 = tiny_cfg(tmp_path, max_steps=1,
+                    exp_manager={"exp_dir": str(tmp_path / "exp2")})
+    cfg2["model_alignment_strategy"] = {"orpo": {"kl_beta": 0.2}}
+    cfg2["model_source"] = "megatron"
+    cfg2["model"]["architecture"] = "gpt"
+    cfg2["model"]["num_layers"] = 4
+    cfg2["distributed_strategy"] = {"pipeline_model_parallel_size": 2}
+    dm2 = DPODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
+    t2 = Trainer.from_config(cfg2, data_module=dm2, enable_checkpointing=False)
+    m2 = t2.fit()
+    assert np.isfinite(m2["loss"])
