@@ -119,15 +119,19 @@ def native_to_hf_llama(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
 
 def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     """HF Mixtral state_dict -> native pytree (fused expert stacking,
-    the reference's ``hf_nxdt_mixtral_ckpt_converter.py:40-60`` role)."""
-    if getattr(cfg, "moe_frequency", 1) != 1:
-        raise NotImplementedError(
-            "checkpoint conversion for moe_frequency > 1 (interleaved "
-            "dense/MoE layout) not supported yet"
-        )
+    the reference's ``hf_nxdt_mixtral_ckpt_converter.py:40-60`` role).
+
+    ``moe_frequency > 1`` (interleaved dense/MoE): HF layer ``i`` is MoE iff
+    ``i % f == 0`` (``block_sparse_moe.*`` keys); dense layers use the Llama
+    ``mlp.{gate,up,down}_proj`` names.  Native ``layers.mlp`` becomes the
+    grouped ``{"moe": [G, ...], "dense": [G, f-1, ...]}`` layout that
+    ``mixtral.init_params`` produces.
+    """
     lc, e = cfg.llama, cfg.moe.num_experts
+    f = getattr(cfg, "moe_frequency", 1)
     g = lambda name: np.asarray(state[name])
     layers = []
+    moe_mlps, dense_mlps = [], []
     for i in range(lc.num_layers):
         pre = f"model.layers.{i}."
         qkv = np.concatenate(
@@ -135,27 +139,55 @@ def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
              _t(g(pre + "self_attn.k_proj.weight")),
              _t(g(pre + "self_attn.v_proj.weight"))], axis=1,
         )
-        gate_up = np.stack([
-            np.concatenate(
-                [_t(g(pre + f"block_sparse_moe.experts.{j}.w1.weight")),
-                 _t(g(pre + f"block_sparse_moe.experts.{j}.w3.weight"))], axis=1)
-            for j in range(e)
-        ])  # [E, H, 2F]
-        down = np.stack([
-            _t(g(pre + f"block_sparse_moe.experts.{j}.w2.weight")) for j in range(e)
-        ])  # [E, F, H]
+        if i % f == 0:
+            gate_up = np.stack([
+                np.concatenate(
+                    [_t(g(pre + f"block_sparse_moe.experts.{j}.w1.weight")),
+                     _t(g(pre + f"block_sparse_moe.experts.{j}.w3.weight"))], axis=1)
+                for j in range(e)
+            ])  # [E, H, 2F]
+            down = np.stack([
+                _t(g(pre + f"block_sparse_moe.experts.{j}.w2.weight"))
+                for j in range(e)
+            ])  # [E, F, H]
+            mlp = {
+                "router": {"w": _t(g(pre + "block_sparse_moe.gate.weight"))},
+                "experts": {"gate_up": gate_up, "down": down},
+            }
+            moe_mlps.append(mlp)
+        else:
+            mlp = {
+                "gate_up": {"w": np.concatenate(
+                    [_t(g(pre + "mlp.gate_proj.weight")),
+                     _t(g(pre + "mlp.up_proj.weight"))], axis=1)},
+                "down": {"w": _t(g(pre + "mlp.down_proj.weight"))},
+            }
+            dense_mlps.append(mlp)
         layers.append({
             "input_norm": {"scale": g(pre + "input_layernorm.weight")},
             "post_attn_norm": {"scale": g(pre + "post_attention_layernorm.weight")},
             "attn": {"qkv": {"w": qkv}, "o": {"w": _t(g(pre + "self_attn.o_proj.weight"))}},
-            "mlp": {
-                "router": {"w": _t(g(pre + "block_sparse_moe.gate.weight"))},
-                "experts": {"gate_up": gate_up, "down": down},
-            },
         })
+    stacked = _stack(layers)
+    if f == 1:
+        stacked["mlp"] = _stack(moe_mlps)
+    else:
+        gcount = lc.num_layers // f
+
+        def regroup(tree):  # [L - G, ...] leaves -> [G, f-1, ...]
+            return {
+                k: (regroup(v) if isinstance(v, dict)
+                    else v.reshape((gcount, f - 1) + v.shape[1:]))
+                for k, v in tree.items()
+            }
+
+        stacked["mlp"] = {
+            "moe": _stack(moe_mlps),
+            "dense": regroup(_stack(dense_mlps)),
+        }
     params: dict[str, Any] = {
         "embed": {"embedding": g("model.embed_tokens.weight")},
-        "layers": _stack(layers),
+        "layers": stacked,
         "final_norm": {"scale": g("model.norm.weight")},
     }
     if not lc.tie_word_embeddings:
@@ -166,13 +198,10 @@ def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
 def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
     """Native Mixtral pytree -> HF state_dict (inverse of
     ``hf_mixtral_to_native``; the reference's nxdt->HF direction,
-    ``hf_nxdt_mixtral_ckpt_converter.py:62-91``)."""
-    if getattr(cfg, "moe_frequency", 1) != 1:
-        raise NotImplementedError(
-            "checkpoint conversion for moe_frequency > 1 (interleaved "
-            "dense/MoE layout) not supported yet"
-        )
+    ``hf_nxdt_mixtral_ckpt_converter.py:62-91``).  Handles the grouped
+    ``moe_frequency > 1`` layout (dense layers emit Llama ``mlp.*`` names)."""
     lc, e = cfg.llama, cfg.moe.num_experts
+    freq = getattr(cfg, "moe_frequency", 1)
     nh, nkv, d = lc.num_attention_heads, lc.kv_heads, lc.head_size
     f = lc.intermediate_size
     out: dict[str, np.ndarray] = {
@@ -181,9 +210,28 @@ def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray
     }
     if "lm_head" in params:  # tied checkpoints simply have no head tensor
         out["lm_head.weight"] = _t(params["lm_head"]["w"])
+    shared = {k: v for k, v in params["layers"].items() if k != "mlp"}
+    mlp_tree = params["layers"]["mlp"]
+
+    def emit_moe(pre: str, mlp) -> None:
+        out[pre + "block_sparse_moe.gate.weight"] = _t(mlp["router"]["w"])
+        gate_up = mlp["experts"]["gate_up"]  # [E, H, 2F]
+        down = mlp["experts"]["down"]  # [E, F, H]
+        for j in range(e):
+            w1, w3 = np.split(np.asarray(gate_up[j]), [f], axis=1)
+            out[pre + f"block_sparse_moe.experts.{j}.w1.weight"] = _t(w1)
+            out[pre + f"block_sparse_moe.experts.{j}.w3.weight"] = _t(w3)
+            out[pre + f"block_sparse_moe.experts.{j}.w2.weight"] = _t(down[j])
+
+    def emit_dense(pre: str, mlp) -> None:
+        gate, up = np.split(np.asarray(mlp["gate_up"]["w"]), 2, axis=1)
+        out[pre + "mlp.gate_proj.weight"] = _t(gate)
+        out[pre + "mlp.up_proj.weight"] = _t(up)
+        out[pre + "mlp.down_proj.weight"] = _t(mlp["down"]["w"])
+
     for i in range(lc.num_layers):
         pre = f"model.layers.{i}."
-        lp = _unstack(params["layers"], i)
+        lp = _unstack(shared, i)
         out[pre + "input_layernorm.weight"] = lp["input_norm"]["scale"]
         out[pre + "post_attention_layernorm.weight"] = lp["post_attn_norm"]["scale"]
         qkv_t = _t(lp["attn"]["qkv"]["w"])  # [(nh+2kv)d, H]
@@ -192,14 +240,13 @@ def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray
         out[pre + "self_attn.k_proj.weight"] = k
         out[pre + "self_attn.v_proj.weight"] = v
         out[pre + "self_attn.o_proj.weight"] = _t(lp["attn"]["o"]["w"])
-        out[pre + "block_sparse_moe.gate.weight"] = _t(lp["mlp"]["router"]["w"])
-        gate_up = lp["mlp"]["experts"]["gate_up"]  # [E, H, 2F]
-        down = lp["mlp"]["experts"]["down"]  # [E, F, H]
-        for j in range(e):
-            w1, w3 = np.split(np.asarray(gate_up[j]), [f], axis=1)
-            out[pre + f"block_sparse_moe.experts.{j}.w1.weight"] = _t(w1)
-            out[pre + f"block_sparse_moe.experts.{j}.w3.weight"] = _t(w3)
-            out[pre + f"block_sparse_moe.experts.{j}.w2.weight"] = _t(down[j])
+        if freq == 1:
+            emit_moe(pre, _unstack(mlp_tree, i))
+        elif i % freq == 0:
+            emit_moe(pre, _unstack(mlp_tree["moe"], i // freq))
+        else:
+            grp = _unstack(mlp_tree["dense"], i // freq)
+            emit_dense(pre, _unstack(grp, i % freq - 1))
     return out
 
 

@@ -148,3 +148,54 @@ def test_mixtral_native_hf_round_trip():
                                           err_msg=path)
 
     eq(jax.tree_util.tree_map(np.asarray, params), back)
+
+
+def test_mixtral_interleaved_round_trip():
+    """moe_frequency=2 (grouped dense/MoE layout): native -> HF -> native is
+    exact; dense layers emit Llama mlp.* names, MoE layers block_sparse_moe.*."""
+    from neuronx_distributed_training_tpu.models import mixtral
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+    from neuronx_distributed_training_tpu.tools.convert import (
+        hf_mixtral_to_native,
+        native_to_hf_mixtral,
+    )
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                       softmax_dtype=jnp.float32)
+    cfg = mixtral.MixtralConfig(
+        llama=llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=4,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        ),
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+        moe_frequency=2,
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg, fp32)
+    hf = native_to_hf_mixtral(params, cfg)
+    # layers 0, 2 are MoE; layers 1, 3 dense with llama mlp names
+    assert "model.layers.0.block_sparse_moe.gate.weight" in hf
+    assert "model.layers.2.block_sparse_moe.experts.3.w2.weight" in hf
+    assert "model.layers.1.mlp.gate_proj.weight" in hf
+    assert "model.layers.3.mlp.down_proj.weight" in hf
+    assert "model.layers.1.block_sparse_moe.gate.weight" not in hf
+    back = hf_mixtral_to_native(hf, cfg)
+
+    def eq(a, b, path=""):
+        if isinstance(a, dict):
+            assert set(a) == set(b), path
+            for k in a:
+                eq(a[k], b[k], path + "/" + k)
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=path)
+
+    eq(jax.tree_util.tree_map(np.asarray, params), back)
+
+    # converted-back params drive the forward identically
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 64)
+    ref, _ = mixtral.forward(params, {"input_ids": ids}, cfg, fp32)
+    got, _ = mixtral.forward(
+        jax.tree_util.tree_map(jnp.asarray, back), {"input_ids": ids}, cfg, fp32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
