@@ -45,30 +45,26 @@ def parse_overrides(pairs: list[str]) -> dict:
 def maybe_init_distributed(jax) -> bool:
     """Multi-host rendezvous from the cluster environment.
 
-    The reference's ``train_setup.sh`` cases (SLURM ``SLURM_NODEID``/nslookup
-    IP list, MPI ``OMPI_COMM_WORLD_RANK``, reference ``train_setup.sh:5-40``)
-    collapse to one call: ``jax.distributed.initialize()`` auto-detects SLURM,
-    Open MPI, and TPU-pod metadata and performs the coordinator handshake —
-    explicit env (``COORDINATOR_ADDRESS``/``NXDT_*``) overrides detection.
+    The reference's ``train_setup.sh`` cases (SLURM nodelist -> MASTER_ADDR,
+    MPI-on-EKS ``OMPI_COMM_WORLD_RANK``, reference ``train_setup.sh:8-67``)
+    are handled by ``utils.launch.detect_cluster`` — an explicit
+    ``(coordinator, num_processes, process_id)`` triple.  TPU-pod metadata
+    (``COORDINATOR_ADDRESS``/``MEGASCALE_*``) keeps jax's own no-arg
+    auto-detection, which owns that handshake.
     """
     env = os.environ
-    # fully-explicit rendezvous only when ALL THREE NXDT_* vars are set; a
-    # bare COORDINATOR_ADDRESS keeps the no-arg auto-detect path (which reads
-    # SLURM_PROCID / OMPI ranks itself) — defaulting num_processes=1 there
-    # would silently split a pod into single-host runs
-    if (env.get("NXDT_COORDINATOR") and env.get("NXDT_NUM_PROCESSES")
-            and env.get("NXDT_PROCESS_ID")):
-        jax.distributed.initialize(
-            coordinator_address=env["NXDT_COORDINATOR"],
-            num_processes=int(env["NXDT_NUM_PROCESSES"]),
-            process_id=int(env["NXDT_PROCESS_ID"]),
-        )
+    from neuronx_distributed_training_tpu.utils.launch import (
+        detect_cluster,
+        initialize_distributed,
+    )
+
+    spec = detect_cluster(env)
+    if spec.is_multiprocess:
+        initialize_distributed(spec)
         return True
-    slurm = int(env.get("SLURM_NTASKS", "1") or 1) > 1
-    ompi = int(env.get("OMPI_COMM_WORLD_SIZE", "1") or 1) > 1
     explicit_env = bool(env.get("COORDINATOR_ADDRESS")
                         or env.get("MEGASCALE_COORDINATOR_ADDRESS"))
-    if slurm or ompi or explicit_env:
+    if explicit_env:
         jax.distributed.initialize()  # jax's built-in cluster auto-detection
         logger.info(
             "distributed: process %d/%d", jax.process_index(), jax.process_count()

@@ -127,7 +127,16 @@ class ExpManager:
         local = 0
         if (global_rank_0_only and g != 0) or (local_rank_0_only and local != 0):
             return None
-        path = self.log_dir / f"nxdt_log_globalrank-{g}_localrank-{local}.txt"
+        # SLURM relaunches write under restart_N/ so earlier logs survive
+        # (reference train_setup.sh:28-29 restart-count log pathing); the
+        # version dir itself is shared so checkpoint auto-resume still works
+        from pathlib import Path
+
+        from neuronx_distributed_training_tpu.utils.launch import restart_log_dir
+
+        log_dir = Path(restart_log_dir(str(self.log_dir)))
+        log_dir.mkdir(parents=True, exist_ok=True)
+        path = log_dir / f"nxdt_log_globalrank-{g}_localrank-{local}.txt"
         handler = logging.FileHandler(path)
         handler.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s [%(name)s] %(message)s"

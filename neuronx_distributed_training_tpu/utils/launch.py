@@ -1,0 +1,158 @@
+"""Cluster detection / multi-host rendezvous — the ``train_setup.sh`` layer.
+
+The reference's launch script (``examples/train_setup.sh:8-67``) cases on the
+cluster environment: SLURM (``SLURM_NNODES``, nodelist -> ``MASTER_ADDR``),
+MPI-on-EKS (``OMPI_COMM_WORLD_RANK``), else single node — then exports the
+rendezvous env for torchrun.  The TPU-native equivalent derives an explicit
+``(coordinator_address, num_processes, process_id)`` triple for
+``jax.distributed.initialize`` from the same environments.
+
+Everything here is a pure function of an env mapping (tests pass fake
+environments); only ``initialize_distributed`` touches jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import Mapping, Optional
+
+logger = logging.getLogger("nxdt.launch")
+
+DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed's own default
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Explicit rendezvous triple + bookkeeping for log paths."""
+
+    coordinator_address: str  # host:port
+    num_processes: int
+    process_id: int
+    managed_by: str  # "nxdt-env" | "slurm" | "ompi" | "single"
+    restart_count: int = 0  # SLURM_RESTART_COUNT (reference train_setup.sh:28-29)
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+
+def expand_first_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist, without DNS.
+
+    Handles the compressed forms scontrol emits: ``node[3-17,20]`` ->
+    ``node3`` (zero-padding preserved: ``node[003-017]`` -> ``node003``),
+    ``a1,b2`` -> ``a1``.  The reference resolves this with
+    ``nslookup $(scontrol show hostnames ...)`` (``train_setup.sh:60-64``);
+    a pure-string parse keeps it testable and dependency-free.
+    """
+    nodelist = nodelist.strip()
+    m = re.match(r"^([^,\[]+)\[([^\]]+)\]", nodelist)
+    if m:
+        prefix, ranges = m.group(1), m.group(2)
+        first = ranges.split(",")[0].split("-")[0]
+        return prefix + first
+    return nodelist.split(",")[0]
+
+
+def detect_cluster(env: Optional[Mapping[str, str]] = None) -> ClusterSpec:
+    """Case on the cluster environment (reference ``train_setup.sh:8-67``).
+
+    Priority: explicit ``NXDT_*`` triple > SLURM > Open MPI > single process.
+    """
+    env = os.environ if env is None else env
+    restart = int(env.get("SLURM_RESTART_COUNT", "0") or 0)
+
+    if (env.get("NXDT_COORDINATOR") and env.get("NXDT_NUM_PROCESSES")
+            and env.get("NXDT_PROCESS_ID")):
+        return ClusterSpec(
+            coordinator_address=env["NXDT_COORDINATOR"],
+            num_processes=int(env["NXDT_NUM_PROCESSES"]),
+            process_id=int(env["NXDT_PROCESS_ID"]),
+            managed_by="nxdt-env",
+            restart_count=restart,
+        )
+
+    ntasks = int(env.get("SLURM_NTASKS", env.get("SLURM_NPROCS", "0")) or 0)
+    if ntasks > 1:
+        nodelist = env.get("SLURM_STEP_NODELIST", env.get("SLURM_NODELIST", ""))
+        if not nodelist:
+            raise RuntimeError(
+                "SLURM environment without SLURM_STEP_NODELIST/SLURM_NODELIST; "
+                "set NXDT_COORDINATOR explicitly"
+            )
+        host = expand_first_host(nodelist)
+        port = env.get("NXDT_COORDINATOR_PORT", str(DEFAULT_COORDINATOR_PORT))
+        return ClusterSpec(
+            coordinator_address=f"{host}:{port}",
+            num_processes=ntasks,
+            process_id=int(env.get("SLURM_PROCID", "0") or 0),
+            managed_by="slurm",
+            restart_count=restart,
+        )
+
+    world = int(env.get("OMPI_COMM_WORLD_SIZE", "0") or 0)
+    if world > 1:
+        # mpirun does not export a coordinator host; the EKS/MPI recipe
+        # (reference train_setup.sh:41-52) provides MASTER_ADDR — honor it.
+        # Without one, defer to jax's own Open MPI plugin (OmpiCluster reads
+        # OMPI_MCA_orte_hnp_uri): empty coordinator -> no-arg initialize.
+        host = env.get("MASTER_ADDR") or env.get("NXDT_COORDINATOR")
+        if host:
+            port = env.get("MASTER_PORT", str(DEFAULT_COORDINATOR_PORT))
+            addr = host if ":" in host else f"{host}:{port}"
+        else:
+            addr = ""
+        return ClusterSpec(
+            coordinator_address=addr,
+            num_processes=world,
+            process_id=int(env.get("OMPI_COMM_WORLD_RANK", "0") or 0),
+            managed_by="ompi" if addr else "ompi-auto",
+            restart_count=restart,
+        )
+
+    return ClusterSpec(
+        coordinator_address="", num_processes=1, process_id=0,
+        managed_by="single", restart_count=restart,
+    )
+
+
+def restart_log_dir(base_dir: str, env: Optional[Mapping[str, str]] = None) -> str:
+    """Per-restart log directory (reference ``train_setup.sh:28-29`` appends
+    the SLURM restart count to the log path so relaunches don't clobber)."""
+    env = os.environ if env is None else env
+    restart = int(env.get("SLURM_RESTART_COUNT", "0") or 0)
+    if restart > 0:
+        return os.path.join(base_dir, f"restart_{restart}")
+    return base_dir
+
+
+def initialize_distributed(spec: Optional[ClusterSpec] = None) -> ClusterSpec:
+    """``jax.distributed.initialize`` from the detected (or given) spec.
+
+    Single-process specs are a no-op; multi-process specs pass the explicit
+    triple (deterministic rendezvous even where jax's own auto-detection has
+    no plugin for the cluster manager).
+    """
+    spec = spec or detect_cluster()
+    if spec.is_multiprocess:
+        import jax
+
+        if spec.coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=spec.coordinator_address,
+                num_processes=spec.num_processes,
+                process_id=spec.process_id,
+            )
+        else:
+            # the cluster manager's own jax plugin owns the handshake
+            # (e.g. OmpiCluster deriving the coordinator from the HNP URI)
+            jax.distributed.initialize()
+        logger.info(
+            "distributed via %s: process %d/%d coordinator=%s",
+            spec.managed_by, spec.process_id, spec.num_processes,
+            spec.coordinator_address or "(auto)",
+        )
+    return spec

@@ -1,0 +1,90 @@
+"""Cluster detection (train_setup.sh equivalent): pure-env parsing."""
+
+import pytest
+
+from neuronx_distributed_training_tpu.utils.launch import (
+    ClusterSpec,
+    detect_cluster,
+    expand_first_host,
+    restart_log_dir,
+)
+
+
+class TestExpandFirstHost:
+    def test_plain(self):
+        assert expand_first_host("node7") == "node7"
+
+    def test_comma_list(self):
+        assert expand_first_host("a1,b2,c3") == "a1"
+
+    def test_bracket_range(self):
+        assert expand_first_host("node[3-17,20]") == "node3"
+
+    def test_zero_padding_preserved(self):
+        assert expand_first_host("trn-[003-017]") == "trn-003"
+
+    def test_bracket_single(self):
+        assert expand_first_host("gpu[12]") == "gpu12"
+
+
+class TestDetectCluster:
+    def test_single_process_default(self):
+        spec = detect_cluster({})
+        assert spec.managed_by == "single"
+        assert not spec.is_multiprocess
+
+    def test_explicit_nxdt_triple_wins(self):
+        spec = detect_cluster({
+            "NXDT_COORDINATOR": "10.0.0.1:9999",
+            "NXDT_NUM_PROCESSES": "4",
+            "NXDT_PROCESS_ID": "2",
+            "SLURM_NTASKS": "8",  # would otherwise pick slurm
+        })
+        assert spec == ClusterSpec("10.0.0.1:9999", 4, 2, "nxdt-env")
+
+    def test_slurm(self):
+        spec = detect_cluster({
+            "SLURM_NTASKS": "16",
+            "SLURM_PROCID": "5",
+            "SLURM_STEP_NODELIST": "trn[001-004]",
+            "SLURM_RESTART_COUNT": "2",
+        })
+        assert spec.managed_by == "slurm"
+        assert spec.coordinator_address == "trn001:8476"
+        assert spec.num_processes == 16
+        assert spec.process_id == 5
+        assert spec.restart_count == 2
+
+    def test_slurm_without_nodelist_raises(self):
+        with pytest.raises(RuntimeError, match="NODELIST"):
+            detect_cluster({"SLURM_NTASKS": "2"})
+
+    def test_ompi_with_master_addr(self):
+        spec = detect_cluster({
+            "OMPI_COMM_WORLD_SIZE": "8",
+            "OMPI_COMM_WORLD_RANK": "3",
+            "MASTER_ADDR": "head.cluster.local",
+            "MASTER_PORT": "1234",
+        })
+        assert spec.managed_by == "ompi"
+        assert spec.coordinator_address == "head.cluster.local:1234"
+        assert spec.process_id == 3
+
+    def test_ompi_without_master_falls_back_to_auto(self):
+        """Plain mpirun (no MASTER_ADDR): defer to jax's own OMPI plugin."""
+        spec = detect_cluster({"OMPI_COMM_WORLD_SIZE": "4",
+                               "OMPI_COMM_WORLD_RANK": "1"})
+        assert spec.managed_by == "ompi-auto"
+        assert spec.coordinator_address == ""
+        assert spec.is_multiprocess and spec.process_id == 1
+
+    def test_single_task_slurm_is_single(self):
+        assert detect_cluster({"SLURM_NTASKS": "1"}).managed_by == "single"
+
+
+class TestRestartLogDir:
+    def test_no_restart(self):
+        assert restart_log_dir("/logs", {}) == "/logs"
+
+    def test_restart_count(self):
+        assert restart_log_dir("/logs", {"SLURM_RESTART_COUNT": "3"}) == "/logs/restart_3"
