@@ -189,12 +189,12 @@ class Trainer:
             # fail early with a clear message instead of an opaque GSPMD error
             moe_freq = int(getattr(model_cfg, "moe_frequency", 1) or 1)
             if moe_freq != 1:
-                if vp > 1:
-                    raise NotImplementedError(
-                        "interleaved pipeline (vp > 1) with moe_frequency > 1"
-                    )
-                # pipe slices whole (MoE + dense) groups; num_moe_layers is
-                # family-specific (mixtral wraps a llama config, gpt is flat)
+                # pipe slices whole (MoE + dense) groups — with vp, every
+                # chunk holds whole groups too (chunk layers = Gc*f, and
+                # to_interleaved reshapes the [G]-leading moe/dense leaves
+                # consistently with the flat [L] attn/norm leaves);
+                # num_moe_layers is family-specific (mixtral wraps a llama
+                # config, gpt is flat)
                 from neuronx_distributed_training_tpu.models import gpt as _gpt
                 from neuronx_distributed_training_tpu.models import mixtral as _mx
 
@@ -202,11 +202,11 @@ class Trainer:
                     groups = _gpt.num_moe_layers(model_cfg)
                 else:
                     groups = _mx.num_moe_layers(model_cfg)
-                if groups % pp != 0:
+                if groups % (pp * vp) != 0:
                     raise ValueError(
                         f"num_layers {model_cfg.num_layers} / moe frequency "
                         f"{moe_freq} = {groups} groups, not divisible by "
-                        f"pipeline_model_parallel_size {pp}"
+                        f"pp*vp = {pp}*{vp}"
                     )
             else:
                 stage_layer_slice(

@@ -591,3 +591,77 @@ def test_gpt_interleaved_pp2_dropout_runs(devices8):
         loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
     assert np.isfinite(float(loss))
     assert np.all(np.isfinite(np.asarray(grads["layers"]["mlp"]["moe"]["router"]["w"])))
+
+
+def test_mixtral_interleaved_vpp_matches_reference(devices8):
+    """moe_frequency=2 under pp=2 x vp=2: grouped leaves ([G]-leading moe,
+    [G, f-1] dense) reshape through to_interleaved consistently with the flat
+    [L] attn/norm leaves (chunk layers = Gc*f)."""
+    import dataclasses
+
+    from neuronx_distributed_training_tpu.models import mixtral
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+    from neuronx_distributed_training_tpu.parallel.pipeline import to_interleaved
+
+    cfg = mixtral.MixtralConfig(
+        llama=dataclasses.replace(CFG, num_layers=8),
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True,
+                              router_aux_loss_coef=0.02),
+        moe_frequency=2,
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg, FP32)
+    mbs = microbatches(jax.random.PRNGKey(1))
+    nm = mbs["input_ids"].shape[0]
+
+    def ref(p, m):
+        def body(acc, mb):
+            loss, _ = mixtral.forward(p, mb, cfg, FP32)
+            return acc + loss, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+        return total / nm
+
+    ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+    pp, vp = 2, 2
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=pp))
+    embed_fn, stage_fn, loss_fn = mixtral.pipeline_hooks(cfg, FP32)
+    inter = to_interleaved(params["layers"], pp, vp)
+    p_inter = {**params, "layers": inter}
+
+    def pl(p, m):
+        return pipeline_loss(
+            p, p["layers"], m,
+            embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+            mesh=mesh, virtual_pipeline_size=vp, stage_aux=True,
+            aux_scale=1.0 / (nm * mixtral.num_moe_layers(cfg)),
+        )
+
+    specs = mixtral.param_specs(cfg, pipeline=True)
+    specs["layers"] = jax.tree_util.tree_map(
+        lambda s: P(None, s[0], None, *tuple(s)[1:]), specs["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        p_inter, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    with mesh, shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, mbs)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    # grads come back in the interleaved layout; compare via to_interleaved(ref)
+    ref_inter = to_interleaved(
+        jax.tree_util.tree_map(np.asarray, ref_g["layers"]), pp, vp)
+    for path in (("mlp", "moe", "router", "w"),
+                 ("mlp", "dense", "gate_up", "w"),
+                 ("attn", "qkv", "w")):
+        g, rg = grads["layers"], ref_inter
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {path}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(grads["embed"]["embedding"]),
+        np.asarray(ref_g["embed"]["embedding"]), rtol=5e-4, atol=1e-5)

@@ -389,3 +389,18 @@ def test_preference_pp_mixtral_and_gpt(tmp_path, devices8):
     t2 = Trainer.from_config(cfg2, data_module=dm2, enable_checkpointing=False)
     m2 = t2.fit()
     assert np.isfinite(m2["loss"])
+
+
+def test_pp_val_batch_size_mismatch_raises(tmp_path, devices8):
+    """Under PP, a val module with a different global batch size must fail
+    fast with a clear error (not deep inside shard_map)."""
+    from neuronx_distributed_training_tpu.data import SyntheticDataModule
+
+    cfg = tiny_cfg(tmp_path, max_steps=1)
+    cfg["distributed_strategy"] = {"pipeline_model_parallel_size": 2}
+    cfg["model"]["num_layers"] = 4
+    val_dm = SyntheticDataModule(vocab_size=128, seq_len=32,
+                                 global_batch_size=4, seed=9)
+    with pytest.raises(ValueError, match="global_batch_size"):
+        Trainer.from_config(cfg, val_data_module=val_dm,
+                            enable_checkpointing=False)
