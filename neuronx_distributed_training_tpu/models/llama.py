@@ -62,7 +62,7 @@ class LlamaConfig:
     sliding_window: Optional[int] = None
     # parallel / fusion behavior
     fuse_qkv: bool = True
-    attention_impl: str = "core"  # "core" | "flash" | "ring"
+    attention_impl: str = "core"  # "core" | "flash" | "ring" | "ulysses"
     flash_block_q: Optional[int] = None   # Pallas tile override (perf tuning)
     flash_block_kv: Optional[int] = None
     vocab_chunks: Optional[int] = None    # fusions.chunked_ce: fused head+CE
@@ -85,7 +85,11 @@ class LlamaConfig:
         m = dict(model_cfg or {})
         ds = dict(ds_cfg or {})
         fusions = dict(m.get("fusions", {}) or {})
-        if fusions.get("ring_attention"):
+        if fusions.get("ulysses_attention"):
+            # all-to-all CP attention — NOT in the reference's fusion set
+            # (SURVEY.md §2.11: no Ulysses); a TPU-native extension
+            impl = "ulysses"
+        elif fusions.get("ring_attention"):
             impl = "ring"
         elif fusions.get("flash_attention"):
             impl = "flash"

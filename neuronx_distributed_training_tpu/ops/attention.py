@@ -124,7 +124,7 @@ def attention(
     k: jax.Array,
     v: jax.Array,
     *,
-    impl: str = "core",  # "core" | "flash" | "ring"
+    impl: str = "core",  # "core" | "flash" | "ring" | "ulysses"
     causal: bool = True,
     q_offset: int = 0,
     sliding_window: Optional[int] = None,
@@ -143,7 +143,7 @@ def attention(
     a padded batch falls back to core with a one-time warning.  Right-padded
     batches under a causal mask don't need it — pads are never attended by
     real tokens — so pretraining/packed-SFT never hits the fallback."""
-    if attention_mask is not None and impl in ("flash", "ring"):
+    if attention_mask is not None and impl in ("flash", "ring", "ulysses"):
         _warn_fallback(f"{impl}+attention_mask")
         impl = "core"
     if impl == "flash":
@@ -168,6 +168,20 @@ def attention(
                     "an explicit q_offset is not meaningful here"
                 )
             return ring_attention(
+                q, k, v, causal=causal, sliding_window=sliding_window
+            )
+    if impl == "ulysses":
+        try:
+            from neuronx_distributed_training_tpu.parallel.ulysses import ulysses_attention
+        except ImportError:
+            _warn_fallback("ulysses")
+        else:
+            if q_offset:
+                raise ValueError(
+                    "ulysses attention derives global positions from the mesh; "
+                    "an explicit q_offset is not meaningful here"
+                )
+            return ulysses_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window
             )
     return core_attention(

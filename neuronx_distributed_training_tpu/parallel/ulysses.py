@@ -1,0 +1,136 @@
+"""Ulysses / all-to-all sequence parallelism over the ``context`` mesh axis.
+
+A capability the reference does NOT have (SURVEY.md §2.11: ``grep -ri ulysses``
+over the reference -> 0 hits; its long-context story is Megatron-SP + ring
+attention only).  DeepSpeed-Ulysses (arXiv:2309.14509) redistributes the
+sequence-sharded activations to HEAD-sharded just for attention:
+
+- outside attention the sequence stays sharded over ``context`` (same layout
+  the ring path uses, so the CP batch split / RoPE offsets / loss machinery
+  in the trainer is shared);
+- ``all_to_all`` #1 (heads -> seq): each rank trades its local sequence chunk
+  of all heads for the FULL sequence of ``h/cp`` heads;
+- attention runs locally per rank with ordinary causal masking (the Pallas
+  flash kernel when shapes tile — no ring step, no online merge);
+- ``all_to_all`` #2 (seq -> heads) restores the sequence-sharded layout.
+
+vs ring attention: 2 all-to-alls instead of ``cp`` ppermutes, no causal-ring
+compute imbalance (every rank does the same triangular work), at the cost of
+requiring ``heads/tp`` divisible by ``cp``.  On ICI the all-to-alls are cheap;
+Ulysses tends to win when ``cp`` is small relative to head count, ring when
+sequence length dominates or cp exceeds the head budget.
+
+GQA KV heads replicate (consecutively) until they divide ``tp*cp``, the same
+``kv_shared_group_size`` trick as the ring path (reference
+``modeling_llama.py:310-320``) — gradients flow through ``jnp.repeat``'s
+transpose (a sum over replicas), so training under replication stays exact.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+
+
+def _ulysses_local(q, k, v, *, axis_name, causal, window, use_flash,
+                   interpret=None):
+    """Per-rank body (inside shard_map, manual over the whole mesh).
+
+    q [b, sq, h_l, d]; k/v [b, sq, kvh_l, d] with sq = s/cp the local
+    sequence chunk and h_l the rank-local head count (h_l % cp == 0,
+    kvh_l % cp == 0 — arranged by the wrapper).
+    """
+    # all-to-all #1: trade head shards for the full sequence
+    qf = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kf = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vf = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    # full-sequence attention on h_l/cp local heads — plain causal, offset 0
+    if use_flash:
+        from neuronx_distributed_training_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        o = flash_attention(qf, kf, vf, causal=causal, sliding_window=window,
+                            interpret=interpret)
+    else:
+        from neuronx_distributed_training_tpu.ops.attention import core_attention
+
+        o = core_attention(qf, kf, vf, causal=causal, sliding_window=window)
+    # all-to-all #2: back to sequence-sharded, all heads local
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [b, s, h, d]  (seq sharded over "context" under GSPMD)
+    k: jax.Array,  # [b, s, kvh, d]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    axis_name: str = "context",
+    mesh=None,
+) -> jax.Array:
+    """All-to-all context-parallel attention over the active mesh.
+
+    Same dispatch contract as ``ring_attention``: falls back to
+    ``core_attention`` when no mesh is active or cp == 1, so the same model
+    code runs in unit tests and CP-off configs.
+    """
+    if not causal:
+        sliding_window = None  # window is a causal concept in this stack
+    mesh = mesh or shd.active_mesh()
+    cp = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
+    if cp == 1:
+        from neuronx_distributed_training_tpu.ops.attention import core_attention
+
+        return core_attention(q, k, v, causal=causal, sliding_window=sliding_window)
+
+    h, kvh = q.shape[2], k.shape[2]
+    tp = int(mesh.shape.get("model", 1))
+    if h % (tp * cp) != 0:
+        raise ValueError(
+            f"ulysses attention: num_heads {h} must be divisible by tp*cp = "
+            f"{tp}*{cp} (use ring attention when cp exceeds the head budget)"
+        )
+    # KV replication until kv heads divide tp*cp while q/kv head groups stay
+    # aligned (consecutive repeat; see module docstring)
+    if kvh % (tp * cp) != 0:
+        if (tp * cp) % kvh != 0:
+            raise ValueError(
+                f"ulysses attention: kv_heads {kvh} and tp*cp {tp * cp} must "
+                f"divide one another"
+            )
+        mult = (tp * cp) // kvh
+        # kvh*mult == tp*cp divides h (checked above), so groups stay aligned
+        k = jnp.repeat(k, mult, axis=2)
+        v = jnp.repeat(v, mult, axis=2)
+
+    q_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
+    kv_spec = P(DATA_AXES, "context", "model" if tp > 1 else None, None)
+
+    from neuronx_distributed_training_tpu.ops.flash_attention import flash_tileable
+
+    s, d = q.shape[1], q.shape[3]
+    h_l = h // tp
+    kvh_l = k.shape[2] // tp
+    # per-rank attention shapes after all-to-all: full seq, h_l/cp heads
+    use_flash = flash_tileable(s, s, d, max(h_l // cp, 1), max(kvh_l // cp, 1))
+    body = functools.partial(
+        _ulysses_local, axis_name=axis_name, causal=causal,
+        window=sliding_window, use_flash=use_flash,
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

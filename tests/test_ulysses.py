@@ -1,0 +1,151 @@
+"""Ulysses (all-to-all CP) attention vs core attention: numerics on a CP mesh.
+
+The reference has no Ulysses implementation (SURVEY.md §2.11) — this is a
+TPU-native extension; parity gates against ``core_attention`` exactly like the
+ring tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.ops.attention import core_attention
+from neuronx_distributed_training_tpu.parallel import sharding as shd
+from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+from neuronx_distributed_training_tpu.parallel.ulysses import ulysses_attention
+
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.slow  # multi-minute parity tests; CI fast tier deselects
+
+
+def make_qkv(key, b=2, s=64, h=4, kvh=None, d=16, dtype=jnp.float32):
+    kvh = kvh or h
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, kvh, d), dtype)
+    v = jax.random.normal(kv, (b, s, kvh, d), dtype)
+    return q, k, v
+
+
+@pytest.fixture(scope="module")
+def cp_mesh():
+    return build_mesh(MeshConfig(context_parallel_size=4))
+
+
+@pytest.fixture(scope="module")
+def cp_tp_mesh():
+    return build_mesh(
+        MeshConfig(context_parallel_size=2, tensor_model_parallel_size=2)
+    )
+
+
+class TestUlyssesNumerics:
+    def test_matches_core_causal(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(0))
+        ref = core_attention(q, k, v, causal=True)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: ulysses_attention(*a, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_matches_core_non_causal(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(1))
+        ref = core_attention(q, k, v, causal=False)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: ulysses_attention(*a, causal=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_gqa_kv_replication(self, cp_mesh):
+        """kvh=2 < cp=4: KV heads replicate to divide cp, groups stay aligned."""
+        q, k, v = make_qkv(jax.random.PRNGKey(2), h=8, kvh=2)
+        ref = core_attention(q, k, v, causal=True)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: ulysses_attention(*a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_grads_match_core(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(3), s=32)
+
+        def loss_uly(q, k, v):
+            return jnp.sum(jnp.square(ulysses_attention(q, k, v, causal=True)))
+
+        def loss_core(q, k, v):
+            return jnp.sum(jnp.square(core_attention(q, k, v, causal=True)))
+
+        ref_grads = jax.grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            grads = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4)
+
+    def test_grads_match_core_with_kv_replication(self, cp_mesh):
+        """Replicated-KV gradients sum over replicas (repeat transpose)."""
+        q, k, v = make_qkv(jax.random.PRNGKey(7), s=32, h=8, kvh=2)
+
+        def loss_uly(q, k, v):
+            return jnp.sum(jnp.square(ulysses_attention(q, k, v, causal=True)))
+
+        def loss_core(q, k, v):
+            return jnp.sum(jnp.square(core_attention(q, k, v, causal=True)))
+
+        ref_grads = jax.grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            grads = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4)
+
+    def test_with_tp_and_cp(self, cp_tp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(4), h=4, kvh=2)
+        ref = core_attention(q, k, v, causal=True)
+        with cp_tp_mesh, shd.use_mesh(cp_tp_mesh):
+            out = jax.jit(lambda *a: ulysses_attention(*a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_sliding_window(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(5))
+        ref = core_attention(q, k, v, causal=True, sliding_window=16)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(
+                lambda *a: ulysses_attention(*a, causal=True, sliding_window=16)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_sharded_inputs(self, cp_mesh):
+        """Inputs already seq-sharded over context: no resharding surprises."""
+        q, k, v = make_qkv(jax.random.PRNGKey(6))
+        spec = P(None, "context", None, None)
+        ns = NamedSharding(cp_mesh, spec)
+        qs, ks, vs = (jax.device_put(x, ns) for x in (q, k, v))
+        ref = core_attention(q, k, v, causal=True)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: ulysses_attention(*a))(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_cp1_fallback(self):
+        q, k, v = make_qkv(jax.random.PRNGKey(8), s=16)
+        ref = core_attention(q, k, v, causal=True)
+        out = ulysses_attention(q, k, v, causal=True)  # no mesh active
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_indivisible_heads_raise(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(9), h=3, kvh=3)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            with pytest.raises(ValueError, match="divisible by tp\\*cp"):
+                ulysses_attention(q, k, v)
+
+    def test_dispatch_selects_ulysses(self, cp_mesh):
+        """fusions.ulysses_attention -> attention_impl and ops.attention route."""
+        from neuronx_distributed_training_tpu.models import llama
+        from neuronx_distributed_training_tpu.ops.attention import attention
+
+        cfg = llama.LlamaConfig.from_config(
+            {"fusions": {"ulysses_attention": True}}, {}
+        )
+        assert cfg.attention_impl == "ulysses"
+        q, k, v = make_qkv(jax.random.PRNGKey(10))
+        ref = core_attention(q, k, v, causal=True)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: attention(*a, impl="ulysses"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
