@@ -89,6 +89,9 @@ class LlamaConfig:
             # all-to-all CP attention — NOT in the reference's fusion set
             # (SURVEY.md §2.11: no Ulysses); a TPU-native extension
             impl = "ulysses"
+        elif fusions.get("zigzag_ring_attention"):
+            # balanced causal ring over the zig-zag layout — also an extension
+            impl = "zigzag_ring"
         elif fusions.get("ring_attention"):
             impl = "ring"
         elif fusions.get("flash_attention"):

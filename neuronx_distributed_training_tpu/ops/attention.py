@@ -143,6 +143,13 @@ def attention(
     a padded batch falls back to core with a one-time warning.  Right-padded
     batches under a causal mask don't need it — pads are never attended by
     real tokens — so pretraining/packed-SFT never hits the fallback."""
+    if attention_mask is not None and impl == "zigzag_ring":
+        # a core fallback would be WRONG here (the batch is zig-zag permuted
+        # and core's causal mask assumes contiguous order) — so raise
+        raise ValueError(
+            "zigzag_ring does not support attention_mask (padded batches); "
+            "use fusions.ring_attention"
+        )
     if attention_mask is not None and impl in ("flash", "ring", "ulysses"):
         _warn_fallback(f"{impl}+attention_mask")
         impl = "core"
@@ -184,6 +191,22 @@ def attention(
             return ulysses_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window
             )
+    if impl == "zigzag_ring":
+        from neuronx_distributed_training_tpu.parallel.ring_attention import (
+            zigzag_ring_attention,
+        )
+
+        if q_offset:
+            raise ValueError(
+                "zigzag ring derives positions from the layout; an explicit "
+                "q_offset is not meaningful here"
+            )
+        if sliding_window is not None:
+            raise ValueError(
+                "zigzag ring does not support sliding_window; use "
+                "ring_attention (contiguous layout) for windowed models"
+            )
+        return zigzag_ring_attention(q, k, v, causal=causal)
     return core_attention(
         q,
         k,

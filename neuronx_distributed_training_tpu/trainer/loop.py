@@ -20,6 +20,7 @@ import logging
 from typing import Any, Callable, Iterator, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_training_tpu.checkpoint import (
@@ -186,6 +187,13 @@ class Trainer:
             from neuronx_distributed_training_tpu.trainer.step import microbatch_split
 
             vp = int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)
+            if getattr(model_cfg, "attention_impl", "") == "zigzag_ring":
+                # the zig-zag batch/position transform lives in the non-PP
+                # loss hook; pipeline stage hooks don't thread positions
+                raise NotImplementedError(
+                    "zigzag_ring_attention under pipeline parallelism; use "
+                    "fusions.ring_attention for pp + cp configs"
+                )
             # fail early with a clear message instead of an opaque GSPMD error
             moe_freq = int(getattr(model_cfg, "moe_frequency", 1) or 1)
             if moe_freq != 1:
@@ -640,8 +648,37 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = Tr
     if arch in ("llama", "mistral"):
         mc = llama.LlamaConfig.from_config(model_block, ds_block)
 
-        def loss_fn(p, batch, key):
-            return llama.forward(p, batch, mc, policy, shift_labels=shift_labels)
+        if mc.attention_impl == "zigzag_ring":
+            # zig-zag CP layout: the loss hook permutes the batch (labels
+            # pre-shifted in ORIGINAL order — the in-model shift is
+            # order-dependent) and feeds matching RoPE positions; cp == 1
+            # makes both transforms the identity
+            from neuronx_distributed_training_tpu.parallel.ring_attention import (
+                zigzag_positions,
+                zigzag_transform_batch,
+            )
+
+            zz_cp = int(ds_block.get("context_parallel_size", 1) or 1)
+            if not shift_labels:
+                raise NotImplementedError(
+                    "zigzag_ring_attention with a pre-shifted data module "
+                    "(the zig-zag transform owns the label shift)"
+                )
+
+            def loss_fn(p, batch, key):
+                zb = zigzag_transform_batch(batch, zz_cp)
+                s = zb["input_ids"].shape[1]
+                pos = jnp.broadcast_to(
+                    zigzag_positions(s, zz_cp)[None, :], zb["input_ids"].shape
+                )
+                return llama.forward(
+                    p, zb, mc, policy, positions=pos, shift_labels=False
+                )
+
+        else:
+
+            def loss_fn(p, batch, key):
+                return llama.forward(p, batch, mc, policy, shift_labels=shift_labels)
 
         return (
             mc,
@@ -653,6 +690,14 @@ def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = Tr
         from neuronx_distributed_training_tpu.models import mixtral
 
         xc = mixtral.MixtralConfig.from_config(model_block, ds_block)
+        if xc.llama.attention_impl == "zigzag_ring":
+            # the zig-zag batch/position transform is wired for the llama
+            # loss hook only; running the op on an unpermuted batch would
+            # silently corrupt the causal structure
+            raise NotImplementedError(
+                "zigzag_ring_attention is llama/mistral-only; use "
+                "fusions.ring_attention for mixtral"
+            )
 
         def loss_fn(p, batch, key):
             return mixtral.forward(p, batch, xc, policy, shift_labels=shift_labels)
@@ -690,6 +735,14 @@ def _forward_logits_for(model_cfg: Any, policy: DtypePolicy):
     dropout for GPT policy forwards (None during the frozen reference pass).
     """
     if isinstance(model_cfg, llama.LlamaConfig):
+        if model_cfg.attention_impl == "zigzag_ring":
+            # preference batches are chosen/rejected sequences, not the
+            # zig-zag-permuted LM batches the layout expects
+            raise NotImplementedError(
+                "zigzag_ring_attention with preference alignment; use "
+                "fusions.ring_attention"
+            )
+
         def fwd(p, b, rng=None):
             logits, _ = llama.forward(
                 p, {"input_ids": b["input_ids"]}, model_cfg, policy)
