@@ -41,7 +41,12 @@ LANES = 128  # TPU lane width; scratch minor dims and block sizes align to it
 SUBLANES = 8  # minor dim for per-row stats (lse/delta): the smallest legal
 # Mosaic block minor dim — 16x less HBM than a full 128-lane broadcast
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
+# v5e sweep (Llama-3-8B layer shapes, seq 8192, 2026-07-30, recorded in
+# bench_results/r2_v5e_measured.jsonl): kv 2048 beats 512 by ~3 MFU points in
+# both regimes (68.3->71.4 bf16, 64.0->66.6 mixed); 4096 fails to fit.  Larger
+# KV blocks amortize the q-block revisit cost; still a per-chip knob via
+# fusions.flash_block_kv.
+DEFAULT_BLOCK_KV = 2048
 NEG_INF = -1e30
 
 

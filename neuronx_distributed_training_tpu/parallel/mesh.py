@@ -132,6 +132,28 @@ class MeshConfig:
         }
 
 
+def dcn_split(dims: tuple[int, ...], num_slices: int) -> tuple[tuple[int, ...], tuple[int, ...]] | None:
+    """Split mesh dims into (DCN shape, per-slice ICI shape) for multi-slice.
+
+    Slow DCN links should carry the least-frequent collectives: gradient
+    reduction (``data``) first, else pipeline stage hops (``pipe``) — the
+    standard multi-slice recipe ("How to Scale Your Model": DP over DCN, the
+    model axes over ICI).  Returns None when neither axis divides
+    ``num_slices`` — ``build_mesh`` treats that as a config error (a mesh
+    whose TP/CP collectives straddle DCN would be quietly catastrophic for
+    step time, so there is deliberately no fallback).
+    Pure function of shapes — unit-testable without TPU slices.
+    """
+    dcn = [1] * len(dims)
+    for axis_idx in (AXES.index("data"), AXES.index("pipe")):
+        if dims[axis_idx] % num_slices == 0:
+            dcn[axis_idx] = num_slices
+            ici = list(dims)
+            ici[axis_idx] = dims[axis_idx] // num_slices
+            return tuple(dcn), tuple(ici)
+    return None
+
+
 def build_mesh(
     config: MeshConfig | None = None,
     devices: Sequence[jax.Device] | None = None,
@@ -142,6 +164,11 @@ def build_mesh(
     ``devices`` defaults to ``jax.devices()``.  Uses ``mesh_utils`` for
     ICI-topology-aware placement on real TPU slices, falling back to a plain
     reshape (CPU test meshes, odd device counts).
+
+    Multi-slice (devices spanning DCN-connected slices): the ``data`` axis —
+    else ``pipe`` — is laid over DCN via ``create_hybrid_device_mesh``, so
+    TP/SP/CP/EP collectives ride ICI and only gradient reductions (or pipe
+    stage hops) cross the slower inter-slice fabric.
     """
     if config is None:
         config = MeshConfig(**kwargs)
@@ -155,15 +182,38 @@ def build_mesh(
 
     dev_array = None
     if devices[0].platform == "tpu":
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        split = None
+        if len(slice_ids) > 1:
+            split = dcn_split(dims, len(slice_ids))
+            if split is None:
+                # config error, raised OUTSIDE the try: a mesh whose TP/CP
+                # collectives straddle DCN must not silently "fall back"
+                raise ValueError(
+                    f"multi-slice mesh: neither data={shape['data']} nor "
+                    f"pipe={shape['pipe']} divides num_slices="
+                    f"{len(slice_ids)}; choose degrees so one does"
+                )
         try:
             from jax.experimental import mesh_utils
 
-            dev_array = mesh_utils.create_device_mesh(dims, devices=list(devices))
+            if split is not None:
+                dcn_shape, ici_shape = split
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, dcn_shape, devices=list(devices)
+                )
+            else:
+                dev_array = mesh_utils.create_device_mesh(
+                    dims, devices=list(devices)
+                )
         except Exception as e:  # noqa: BLE001 — fall back, but loudly: a
-            # topology-oblivious mesh silently degrades collective bandwidth.
+            # topology-oblivious mesh silently degrades collective bandwidth
+            # (mesh_utils raises ValueError for unmappable topologies too, so
+            # no exception class is excluded here)
             logger.warning(
-                "mesh_utils.create_device_mesh(%s) failed (%s); falling back to "
-                "plain reshape — ICI-topology-aware placement lost", dims, e
+                "mesh_utils device-mesh construction (%s) failed (%s); falling "
+                "back to plain reshape — ICI-topology-aware placement lost",
+                dims, e
             )
             dev_array = None
     if dev_array is None:

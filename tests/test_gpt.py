@@ -223,6 +223,7 @@ class TestGPTMoEFrequency:
         with pytest.raises(ValueError, match="frequency"):
             gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
 
+    @pytest.mark.slow  # fit()-based; 40 s — keeps the CI fast tier < 5 min
     def test_interleave_under_pp_trains(self, devices8):
         """gpt + moe_frequency>1 + pp=2 now trains end-to-end (grouped stage
         slicing); one fit() step produces a finite loss."""

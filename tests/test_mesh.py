@@ -69,3 +69,28 @@ def test_batch_partition_spec(devices8):
     mesh = build_mesh(MeshConfig(context_parallel_size=2))
     spec = batch_partition_spec(mesh, context_sharded_seq=True)
     assert spec == jax.sharding.PartitionSpec(("data", "expert"), "context")
+
+
+class TestDcnSplit:
+    """Multi-slice layout: DP (else PP) over DCN, everything else over ICI."""
+
+    def test_data_axis_preferred(self):
+        from neuronx_distributed_training_tpu.parallel.mesh import AXES, dcn_split
+
+        dims = (2, 8, 1, 1, 4)  # pipe, data, expert, context, model
+        dcn, ici = dcn_split(dims, 4)
+        assert dcn == (1, 4, 1, 1, 1)
+        assert ici == (2, 2, 1, 1, 4)
+
+    def test_pipe_fallback(self):
+        from neuronx_distributed_training_tpu.parallel.mesh import dcn_split
+
+        dims = (4, 3, 1, 1, 4)  # data=3 does not divide 2 slices; pipe=4 does
+        dcn, ici = dcn_split(dims, 2)
+        assert dcn == (2, 1, 1, 1, 1)
+        assert ici == (2, 3, 1, 1, 4)
+
+    def test_no_axis_divides(self):
+        from neuronx_distributed_training_tpu.parallel.mesh import dcn_split
+
+        assert dcn_split((3, 5, 1, 1, 4), 2) is None
