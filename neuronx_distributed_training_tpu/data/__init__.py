@@ -18,6 +18,7 @@ from neuronx_distributed_training_tpu.data.packing import (  # noqa: F401
 from neuronx_distributed_training_tpu.data.loader import (  # noqa: F401
     DataModule,
     HFDataModule,
+    PrefetchIterator,
     SyntheticDataModule,
     process_global_batch,
 )

@@ -16,6 +16,8 @@ reference, by design:
 from __future__ import annotations
 
 import os
+import queue
+import threading
 from typing import Any, Iterator, Optional, Sequence
 
 import jax
@@ -25,6 +27,75 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX
 from neuronx_distributed_training_tpu.data.sampler import PretrainingSampler, RandomSampler
 from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
+
+
+class PrefetchIterator:
+    """Bounded background prefetch over a batch iterator.
+
+    The reference overlaps host batch prep with device compute via
+    ``MpDeviceLoader`` (``base.py:330-350``); here JAX's async dispatch covers
+    most of it, but a slow ``fetch_rows`` (arrow page-in, mmap faults) on the
+    loop thread still stalls dispatch.  A daemon thread keeps ``depth``
+    batches ready in a queue; exceptions propagate to the consumer at the
+    point they would have occurred.  ``close()`` (or GC) stops the thread.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+
+        def put(item) -> bool:
+            """Enqueue unless close() intervened — EVERY producer put (data,
+            terminal sentinel, exception) must honor _stop, or the daemon
+            thread blocks forever on a full queue after close(), pinning the
+            queued device batches for process lifetime."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run() -> None:
+            try:
+                for item in it:
+                    if not put(item):
+                        return
+                put(self._DONE)
+            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                put(e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="nxdt-prefetch")
+        self._thread.start()
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        # timeout loop so a consumer blocked here wakes up after close()
+        # (the producer may have died without enqueueing the sentinel)
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def __del__(self) -> None:  # pragma: no cover — belt and braces
+        self._stop.set()
 
 
 def process_global_batch(

@@ -206,10 +206,15 @@ def build_mesh(
                 dev_array = mesh_utils.create_device_mesh(
                     dims, devices=list(devices)
                 )
-        except Exception as e:  # noqa: BLE001 — fall back, but loudly: a
-            # topology-oblivious mesh silently degrades collective bandwidth
-            # (mesh_utils raises ValueError for unmappable topologies too, so
-            # no exception class is excluded here)
+        except Exception as e:  # noqa: BLE001 — single-slice only: fall back,
+            # but loudly (a topology-oblivious mesh degrades collective
+            # bandwidth; mesh_utils raises ValueError for unmappable
+            # topologies too, so no exception class is excluded here)
+            if split is not None:
+                # multi-slice: a plain reshape would interleave slices along
+                # the inner axes — TP/CP collectives straddling DCN, the
+                # outcome the indivisible-config raise above exists to prevent
+                raise
             logger.warning(
                 "mesh_utils device-mesh construction (%s) failed (%s); falling "
                 "back to plain reshape — ICI-topology-aware placement lost",

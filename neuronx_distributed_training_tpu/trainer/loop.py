@@ -29,7 +29,11 @@ from neuronx_distributed_training_tpu.checkpoint import (
     TrainState,
 )
 from neuronx_distributed_training_tpu.config.loader import ConfigDict, batch_schedule
-from neuronx_distributed_training_tpu.data import DataModule, SyntheticDataModule
+from neuronx_distributed_training_tpu.data import (
+    DataModule,
+    PrefetchIterator,
+    SyntheticDataModule,
+)
 from neuronx_distributed_training_tpu.models import llama
 from neuronx_distributed_training_tpu.optim.adamw import (
     AdamWConfig,
@@ -475,6 +479,14 @@ class Trainer:
 
     # -- resume -------------------------------------------------------------
 
+    @property
+    def consumed_samples(self) -> int:
+        """Derived from TRAINED steps (the reference's
+        ``compute_consumed_samples``, ``data/base.py:33-47``) — NOT from the
+        sampler's yield counter, which runs ahead of training by the prefetch
+        queue depth."""
+        return self.step * int(self.data_module.global_batch_size)
+
     def maybe_resume(self) -> bool:
         """Restore newest checkpoint if one exists (reference ``resume_if_exists``)."""
         if self.checkpointer is None or self.checkpointer.latest_step() is None:
@@ -528,7 +540,10 @@ class Trainer:
             self.pre_fit(self)
         self.maybe_resume()
         last_metrics: dict[str, float] = {}
-        batches = self.data_module.sharded_batches(self.mesh)
+        # background prefetch: slow fetch_rows (arrow page-in, mmap faults)
+        # must not stall dispatch (the reference's MpDeviceLoader role);
+        # shard_batch uses an explicit NamedSharding, so it is thread-safe
+        batches = PrefetchIterator(self.data_module.sharded_batches(self.mesh))
         log_every = max(1, int(self.exp.log_every_n_steps))
         try:
             with self.mesh, shd.use_mesh(self.mesh):
@@ -564,7 +579,7 @@ class Trainer:
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     dt = self.exp.step_timed(n_since)
                     last_metrics["step_time"] = dt
-                    last_metrics["consumed_samples"] = self.data_module.consumed_samples
+                    last_metrics["consumed_samples"] = self.consumed_samples
                     self.exp.log_metrics(self.step, last_metrics)
 
                     if val_interval and self.step % val_interval == 0 and self.eval_step:
@@ -588,6 +603,7 @@ class Trainer:
                         and stop_requested["reason"] is None):
                     self.save_checkpoint(last_metrics)  # final save
         finally:
+            batches.close()
             if old_handler is not None:
                 import signal as _signal
 
@@ -625,7 +641,7 @@ class Trainer:
                 params=self.params,
                 opt_state=self.opt_state,
                 step=self.step,
-                consumed_samples=self.data_module.consumed_samples,
+                consumed_samples=self.consumed_samples,
             ),
             metrics=metrics,
         )

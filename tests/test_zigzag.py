@@ -110,6 +110,21 @@ class TestZigzagNumerics:
                 zigzag_ring_attention(q, k, v, causal=False)
 
 
+    def test_with_tp_and_cp(self):
+        """zig-zag composes with TP (heads over model) + GQA replication."""
+        mesh = build_mesh(MeshConfig(context_parallel_size=2,
+                                     tensor_model_parallel_size=2))
+        q, k, v = make_qkv(jax.random.PRNGKey(9), h=4, kvh=2)
+        pos = zigzag_positions(64, 2)
+        inv = jnp.argsort(pos)
+        ref = core_attention(q, k, v, causal=True)
+        qz, kz, vz = (jnp.take(x, pos, axis=1) for x in (q, k, v))
+        with mesh, shd.use_mesh(mesh):
+            oz = jax.jit(lambda *a: zigzag_ring_attention(*a))(qz, kz, vz)
+        np.testing.assert_allclose(
+            np.asarray(jnp.take(oz, inv, axis=1)), np.asarray(ref), atol=2e-5)
+
+
 class TestZigzagTrainer:
     def test_loss_matches_contiguous_ring(self, devices8):
         """The full trainer loss hook (permute + pre-shift + positions) under
