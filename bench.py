@@ -123,6 +123,15 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
             vocab_params = 2 * vocab * h
             budget_params = hbm_bytes * 0.60 / bytes_per_param
             layers = max(1, min(32, int((budget_params - vocab_params) // per_layer)))
+        # long sequences: the [s, vocab] logits tensor (s*vocab*4B fp32)
+        # dominates HBM — switch to the fused chunked head+CE, which never
+        # materializes it (fusions.chunked_ce).  Fixed 8 GiB threshold, NOT a
+        # fraction of measured HBM: the flagship seq-8192 point (~4.2 GB
+        # logits) must always bench un-chunked so runs stay comparable to the
+        # recorded baselines regardless of runtime HBM reservation.
+        vocab_chunks = 16 if seq * vocab * 4 > 8 * 1024**3 else None
+        if vocab_chunks:
+            log(f"bench: seq {seq} logits exceed 8 GiB — chunked_ce x{vocab_chunks}")
         return llama.LlamaConfig(
             vocab_size=vocab,
             hidden_size=h,
@@ -136,6 +145,7 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
             attention_impl=attn_impl,
             flash_block_q=block_q,
             flash_block_kv=block_kv,
+            vocab_chunks=vocab_chunks,
             activations_checkpoint_granularity="selective",
         )
     return llama.LlamaConfig(

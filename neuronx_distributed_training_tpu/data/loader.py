@@ -45,15 +45,19 @@ class PrefetchIterator:
     def __init__(self, it: Iterator, depth: int = 2):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        # the thread target captures ONLY the queue/event/sentinel — never
+        # self — so an abandoned iterator stays collectible: __del__ then
+        # fires, stops the thread, and the queued device batches are freed
+        q, stop, done = self._q, self._stop, PrefetchIterator._DONE
 
         def put(item) -> bool:
             """Enqueue unless close() intervened — EVERY producer put (data,
-            terminal sentinel, exception) must honor _stop, or the daemon
-            thread blocks forever on a full queue after close(), pinning the
-            queued device batches for process lifetime."""
-            while not self._stop.is_set():
+            terminal sentinel, exception) must honor the stop event, or the
+            daemon thread blocks forever on a full queue after close(),
+            pinning the queued device batches for process lifetime."""
+            while not stop.is_set():
                 try:
-                    self._q.put(item, timeout=0.1)
+                    q.put(item, timeout=0.1)
                     return True
                 except queue.Full:
                     continue
@@ -64,7 +68,7 @@ class PrefetchIterator:
                 for item in it:
                     if not put(item):
                         return
-                put(self._DONE)
+                put(done)
             except BaseException as e:  # noqa: BLE001 — re-raised at consumer
                 put(e)
 
@@ -86,8 +90,12 @@ class PrefetchIterator:
                 if self._stop.is_set():
                     raise StopIteration
         if item is self._DONE:
+            # terminal: mark stopped so REPEAT next() calls keep raising
+            # StopIteration (iterator protocol) instead of polling forever
+            self._stop.set()
             raise StopIteration
         if isinstance(item, BaseException):
+            self._stop.set()  # producer is dead; further next() terminates
             raise item
         return item
 

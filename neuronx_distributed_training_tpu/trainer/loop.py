@@ -372,6 +372,19 @@ class Trainer:
         params = put(params, pspecs)
         opt_state = put(opt_state, ospecs)
 
+        # opt-in sharding sanity gate (SURVEY.md §5.2 "jit-time shape/sharding
+        # assertions" — the TPU-native analogue of the reference's
+        # HLO-consistency discipline): fail fast on silent replication or a
+        # dropped constraint instead of discovering it as a perf mystery
+        if bool((cfg.get("debug", {}) or {}).get("validate_sharding")):
+            from neuronx_distributed_training_tpu.utils.debug import (
+                assert_tree_sharding,
+            )
+
+            assert_tree_sharding(params, pspecs, mesh)
+            assert_tree_sharding(opt_state, ospecs, mesh)
+            logger.info("debug.validate_sharding: params + opt state verified")
+
         # warm start: weights only, no optimizer/loop state (the reference's
         # weight_init_only + resume_from_checkpoint SFT/DPO recipe,
         # nlp_overrides.py:541-568)

@@ -17,6 +17,7 @@ from neuronx_distributed_training_tpu.data.sampler import (
     consumed_samples_from_name,
     dp_shard,
 )
+from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
 
 
 def take(it, n):
@@ -204,14 +205,10 @@ class TestNativePacker:
 
 class TestPrefetchIterator:
     def test_order_preserved(self):
-        from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
-
         it = PrefetchIterator(iter(range(50)), depth=4)
         assert list(it) == list(range(50))
 
     def test_exception_propagates(self):
-        from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
-
         def gen():
             yield 1
             raise RuntimeError("boom")
@@ -224,8 +221,6 @@ class TestPrefetchIterator:
     def test_close_stops_producer(self):
         import itertools
         import time
-
-        from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
 
         produced = []
 
@@ -244,8 +239,6 @@ class TestPrefetchIterator:
 
     def test_runs_ahead(self):
         import time
-
-        from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
 
         produced = []
 
@@ -266,8 +259,6 @@ def test_prefetch_close_with_full_queue_unblocks_producer():
     is full at exhaustion time, and a late consumer wakes instead of hanging."""
     import time
 
-    from neuronx_distributed_training_tpu.data.loader import PrefetchIterator
-
     it = PrefetchIterator(iter(range(3)), depth=1)  # queue full immediately
     time.sleep(0.2)
     it.close()
@@ -275,3 +266,28 @@ def test_prefetch_close_with_full_queue_unblocks_producer():
     assert not it._thread.is_alive()
     # post-close consumption terminates (drains then StopIteration) — no hang
     list(it)
+
+
+def test_prefetch_repeat_next_after_exhaustion_raises():
+    """Iterator protocol: next() after StopIteration keeps raising (no hang)."""
+    it = PrefetchIterator(iter([1, 2]), depth=1)
+    assert list(it) == [1, 2]
+    with pytest.raises(StopIteration):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_next_after_exception_terminates():
+    """After a propagated producer error, further next() raises StopIteration
+    instead of polling forever."""
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = PrefetchIterator(gen(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)

@@ -136,3 +136,27 @@ class TestTrainStepCollectives:
         gathers = counts["all-gather"]
         assert reductions >= 1, counts   # TP grad/activation reductions
         assert gathers >= 1, counts      # ZeRO-1 sharded-update re-gather
+
+
+def test_trainer_validate_sharding_gate(tmp_path, devices8):
+    """debug.validate_sharding: the trainer asserts param/opt-state layouts at
+    build time (and passes on a correct config)."""
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+    cfg = load_config({
+        "name": "dbg", "model_source": "hf", "seed": 1,
+        "trainer": {"max_steps": 1},
+        "exp_manager": {"exp_dir": str(tmp_path / "exp")},
+        "debug": {"validate_sharding": True},
+        "distributed_strategy": {"tensor_model_parallel_size": 2},
+        "data": {"global_batch_size": 4, "micro_batch_size": 1,
+                 "seq_length": 16, "synthetic": True},
+        "model": {"vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+                  "num_layers": 2, "num_attention_heads": 4,
+                  "num_key_value_heads": 2, "max_position_embeddings": 16,
+                  "optim": {"lr": 1e-3}},
+        "precision": {"type": "mixed_precision"},
+    })
+    t = Trainer.from_config(cfg, enable_checkpointing=False)  # no raise
+    assert t.params is not None
