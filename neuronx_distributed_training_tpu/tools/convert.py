@@ -54,6 +54,46 @@ def _unstack(tree: dict[str, Any], i: int) -> dict[str, Any]:
     }
 
 
+def deinterleave_layers(params: Mapping[str, Any], num_layers: int,
+                        moe_frequency: int = 1) -> dict[str, Any]:
+    """Flatten a pipeline-interleaved ``layers`` stack back to ``[L, ...]``.
+
+    Checkpoints trained under virtual pipeline parallelism store layers in the
+    ``to_interleaved`` layout ``[vp, pp, Lc, ...]`` (``trainer/loop.py`` keeps
+    the training layout in the checkpoint).  Detected per leaf against the
+    EXPECTED leading count (``L``, or the group count ``G = L/f`` for grouped
+    MoE leaves): interleaved leaves have their first three dims multiply to
+    the expected count (``vp*pp*Lc == L``) where flat leaves lead with it —
+    unambiguous, since a flat leaf's first three dims multiply to
+    ``L * <param dims> > L``.  The reshape is exactly ``from_interleaved``
+    (stage-major order).  No-op for already-flat params.
+    """
+
+    def flat(x, expect: int):
+        x = np.asarray(x)
+        if (x.ndim >= 3 and x.shape[0] != expect
+                and x.shape[0] * x.shape[1] * x.shape[2] == expect):
+            return x.reshape((expect,) + x.shape[3:])
+        return x
+
+    def visit(tree, expect: int):
+        result = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                if k == "mlp" and ("moe" in v or "dense" in v):
+                    g = num_layers // moe_frequency
+                    result[k] = {kk: visit(vv, g) for kk, vv in v.items()}
+                else:
+                    result[k] = visit(v, expect)
+            else:
+                result[k] = flat(v, expect)
+        return result
+
+    out = dict(params)
+    out["layers"] = visit(dict(params["layers"]), num_layers)
+    return out
+
+
 def hf_llama_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     """HF Llama state_dict (name -> array-like) -> native param pytree.
 
@@ -91,7 +131,11 @@ def hf_llama_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
 
 
 def native_to_hf_llama(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
-    """Native param pytree -> HF Llama state_dict (numpy)."""
+    """Native param pytree -> HF Llama state_dict (numpy).
+
+    VPP-trained checkpoints (interleaved ``[vp, pp, Lc, ...]`` layer layout)
+    are flattened transparently."""
+    params = deinterleave_layers(params, cfg.num_layers)
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     out: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
@@ -199,9 +243,11 @@ def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray
     """Native Mixtral pytree -> HF state_dict (inverse of
     ``hf_mixtral_to_native``; the reference's nxdt->HF direction,
     ``hf_nxdt_mixtral_ckpt_converter.py:62-91``).  Handles the grouped
-    ``moe_frequency > 1`` layout (dense layers emit Llama ``mlp.*`` names)."""
+    ``moe_frequency > 1`` layout (dense layers emit Llama ``mlp.*`` names)
+    and flattens VPP-interleaved checkpoints transparently."""
     lc, e = cfg.llama, cfg.moe.num_experts
     freq = getattr(cfg, "moe_frequency", 1)
+    params = deinterleave_layers(params, lc.num_layers, freq)
     nh, nkv, d = lc.num_attention_heads, lc.kv_heads, lc.head_size
     f = lc.intermediate_size
     out: dict[str, np.ndarray] = {

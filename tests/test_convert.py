@@ -199,3 +199,64 @@ def test_mixtral_interleaved_round_trip():
     got, _ = mixtral.forward(
         jax.tree_util.tree_map(jnp.asarray, back), {"input_ids": ids}, cfg, fp32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+def test_vpp_interleaved_checkpoint_converts():
+    """A VPP-trained checkpoint (interleaved [vp, pp, Lc, ...] layer layout)
+    converts to HF identically to its flat-layout equivalent."""
+    from neuronx_distributed_training_tpu.parallel.pipeline import to_interleaved
+    from neuronx_distributed_training_tpu.tools.convert import native_to_hf_llama
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                       softmax_dtype=jnp.float32)
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=8,
+        num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+        activations_checkpoint_granularity=None,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, fp32)
+    ref = native_to_hf_llama(params, cfg)
+    inter = dict(params)
+    inter["layers"] = to_interleaved(params["layers"], pp=2, vp=2)
+    got = native_to_hf_llama(inter, cfg)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(ref[k]),
+                                      err_msg=k)
+
+
+@pytest.mark.parametrize("freq", [2, 4])
+def test_vpp_interleaved_mixtral_grouped_converts(freq):
+    """Interleaved + grouped mixtral checkpoint converts identically to the
+    flat layout.  freq=4 exercises the case where group count (L/f) differs
+    from the dense-layer count (L - L/f): the dense stack LEADS with the
+    group count, so the same expect applies to both moe and dense leaves."""
+    from neuronx_distributed_training_tpu.models import mixtral
+    from neuronx_distributed_training_tpu.ops import moe as moe_ops
+    from neuronx_distributed_training_tpu.parallel.pipeline import to_interleaved
+    from neuronx_distributed_training_tpu.tools.convert import native_to_hf_mixtral
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                       softmax_dtype=jnp.float32)
+    cfg = mixtral.MixtralConfig(
+        llama=llama.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=8,
+            num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+            activations_checkpoint_granularity=None,
+        ),
+        moe=moe_ops.MoEConfig(num_experts=4, top_k=2, dropless=True),
+        moe_frequency=freq,
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg, fp32)
+    ref = native_to_hf_mixtral(params, cfg)
+    inter = dict(params)
+    # pp*vp must divide the group count L/freq: (2,2) for G=4, (2,1) for G=2
+    pp, vp = (2, 2) if freq == 2 else (2, 1)
+    inter["layers"] = to_interleaved(params["layers"], pp=pp, vp=vp)
+    got = native_to_hf_mixtral(inter, cfg)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(ref[k]),
+                                      err_msg=k)
