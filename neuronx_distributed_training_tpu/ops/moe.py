@@ -50,6 +50,9 @@ class MoEConfig:
     router_z_loss_coef: float = 0.0
     normalize_top_k_affinities: bool = True  # Mixtral renormalizes top-k probs
     sinkhorn_iterations: int = 8
+    # de-bias capacity drops from sequence position (reference
+    # token_shuffle_group_size, transformer.py:410-411); dropped path only
+    token_shuffle_group_size: int = 0
 
     @classmethod
     def from_config(cls, moe_cfg: dict[str, Any]) -> "MoEConfig":
@@ -65,6 +68,7 @@ class MoEConfig:
             router_aux_loss_coef=float(m.get("router_aux_loss_coef", 0.02)),
             router_z_loss_coef=float(m.get("router_z_loss_coef", 0.0)),
             normalize_top_k_affinities=bool(m.get("normalize_top_k_affinities", True)),
+            token_shuffle_group_size=int(m.get("token_shuffle_group_size", 0) or 0),
         )
 
 
@@ -258,10 +262,37 @@ def moe_dropless(params, x: jax.Array, cfg: MoEConfig, *, compute_dtype=jnp.bflo
     return y.astype(x.dtype), (probs, idx, logits)
 
 
+def _shuffle_permutation(t: int, group: int) -> jnp.ndarray:
+    """Deterministic stride (interleave) permutation of ``t`` tokens.
+
+    The reference's ``token_shuffle_group_size`` (``transformer.py:410-411``)
+    randomly shuffles tokens before capacity-factor dispatch so over-capacity
+    DROPS are not biased toward late sequence positions (the expert queue
+    position is a cumsum in token order).  A fixed stride permutation —
+    read the flat token stream as ``[group, t/group]`` column-major — achieves
+    the same positional de-correlation deterministically: adjacent sequence
+    positions land ``t/group`` apart in the queue.  No PRNG threading, no
+    cross-step nondeterminism, exact inverse by transposition.
+    """
+    g = max(1, min(group, t))
+    while t % g:
+        g -= 1  # largest divisor <= group (tiny/odd token counts)
+    return jnp.arange(t).reshape(t // g, g).T.reshape(-1)
+
+
 def moe_block(params, x: jax.Array, cfg: MoEConfig, *, compute_dtype=jnp.bfloat16):
     """[b, s, h] wrapper dispatching dropped/dropless; returns (y, router_logits)."""
     b, s, h = x.shape
     flat = x.reshape(b * s, h)
+    shuffle = (not cfg.dropless) and (cfg.token_shuffle_group_size or 0) > 1
+    if shuffle:
+        # only the dropped path is order-dependent (queue-position cumsum);
+        # dropless processes every token, so shuffling there is a no-op cost
+        perm = _shuffle_permutation(b * s, int(cfg.token_shuffle_group_size))
+        inv = jnp.argsort(perm)
+        flat = flat[perm]
     fn = moe_dropless if cfg.dropless else moe_dropped
     y, (probs, idx, logits) = fn(params, flat, cfg, compute_dtype=compute_dtype)
+    if shuffle:
+        y, idx, logits = y[inv], idx[inv], logits[inv]
     return y.reshape(b, s, h), {"router_logits": logits, "expert_idx": idx}

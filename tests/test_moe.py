@@ -124,3 +124,74 @@ class TestEP:
                 lambda p, xx: moe.moe_dropped(p, xx, cfg, compute_dtype=jnp.float32)
             )(sh_params, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
+
+
+class TestTokenShuffle:
+    """token_shuffle_group_size (reference transformer.py:410-411): de-bias
+    capacity drops from sequence position in the dropped path."""
+
+
+    def test_permutation_is_bijective(self):
+        from neuronx_distributed_training_tpu.ops.moe import _shuffle_permutation
+
+        for t, g in ((64, 8), (48, 7), (5, 16), (1, 4)):
+            p = np.asarray(_shuffle_permutation(t, g))
+            assert sorted(p.tolist()) == list(range(t)), (t, g)
+
+    def test_dropless_output_unchanged(self):
+        """Shuffle is a dropped-path concept; dropless output is identical."""
+        import dataclasses
+
+        cfg = moe.MoEConfig(num_experts=4, top_k=2, dropless=True)
+        params = moe.init_moe_params(jax.random.PRNGKey(0), 16, 32, cfg,
+                                 dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+        y0, _ = moe.moe_block(params, x, cfg, compute_dtype=jnp.float32)
+        cfg2 = dataclasses.replace(cfg, token_shuffle_group_size=4)
+        y1, _ = moe.moe_block(params, x, cfg2, compute_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+
+    def test_dropped_shuffle_debiases_position(self):
+        """With tight capacity, unshuffled drops pile onto LATE positions;
+        the stride shuffle spreads them across the sequence."""
+        import dataclasses
+
+        cfg = moe.MoEConfig(num_experts=2, top_k=1, dropless=False,
+                        capacity_factor=0.5)
+        params = moe.init_moe_params(jax.random.PRNGKey(0), 16, 32, cfg,
+                                 dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 16), jnp.float32)
+
+        def dropped_positions(c):
+            y, _aux = moe.moe_block(params, x, c, compute_dtype=jnp.float32)
+            # a dropped token passes through as exactly zero output
+            return np.nonzero(np.all(np.asarray(y[0]) == 0.0, axis=-1))[0]
+
+        base = dropped_positions(cfg)
+        shuf = dropped_positions(
+            dataclasses.replace(cfg, token_shuffle_group_size=8))
+        assert len(base) > 0  # capacity 0.5 guarantees drops
+        # same total drop budget (capacity unchanged)
+        assert abs(len(base) - len(shuf)) <= 2
+        # unshuffled: drops concentrate in the back half; shuffled: spread out
+        assert np.mean(base) > 32
+        assert np.mean(shuf) < np.mean(base)
+
+    def test_shuffled_outputs_keep_token_alignment(self):
+        """Kept tokens produce the same expert output with and without
+        shuffle when nothing is dropped (capacity ample)."""
+        import dataclasses
+
+        cfg = moe.MoEConfig(num_experts=2, top_k=1, dropless=False,
+                        capacity_factor=4.0)
+        params = moe.init_moe_params(jax.random.PRNGKey(0), 16, 32, cfg,
+                                 dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16), jnp.float32)
+        y0, a0 = moe.moe_block(params, x, cfg, compute_dtype=jnp.float32)
+        y1, a1 = moe.moe_block(
+            params, x, dataclasses.replace(cfg, token_shuffle_group_size=4),
+            compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(a0["expert_idx"]),
+                                      np.asarray(a1["expert_idx"]))
