@@ -134,7 +134,14 @@ class Trainer:
         model_cfg, loss_fn, init_fn, specs_fn = build_model(
             cfg, policy, shift_labels=shift_labels
         )
-        params = init_fn(jax.random.PRNGKey(seed))
+        # params are NOT materialized here: param_builder composes init +
+        # LoRA + pipeline-interleave as one pure function, jitted later with
+        # out_shardings so every leaf is born sharded on its own devices —
+        # the TPU-native form of the reference's meta-device init +
+        # sequential_move_factor staged moves (base.py:147-152, 693-712);
+        # a 405B-class config never materializes unsharded params anywhere
+        init_key = jax.random.PRNGKey(seed)
+        param_builder = init_fn
 
         # DPO/ORPO swap the loss for the preference objective; DPO's pre-fit
         # reference-logprob pass runs in fit() (reference base_dpo.py:23-66),
@@ -167,8 +174,10 @@ class Trainer:
             )
 
             lora_cfg = _LoraConfig.from_config(lora_block)
-            params = add_lora(params, lora_cfg, jax.random.PRNGKey(seed + 1))
-            trainable = trainable_mask(params)
+            lora_key = jax.random.PRNGKey(seed + 1)
+            base_builder = param_builder
+            param_builder = lambda key: add_lora(base_builder(key), lora_cfg, lora_key)
+            # trainable mask is built later from the one shared eval_shape
             base_specs_fn = specs_fn
             specs_fn = lambda **kw: lora_param_specs(base_specs_fn(**kw), lora_cfg)
 
@@ -318,7 +327,12 @@ class Trainer:
             eval_loss_fn = loss_fn
             pspecs = specs_fn(pipeline=True)
             if vp > 1:
-                params["layers"] = to_interleaved(params["layers"], pp, vp)
+                flat_builder = param_builder
+
+                def param_builder(key):
+                    p = flat_builder(key)
+                    return {**p, "layers": to_interleaved(p["layers"], pp, vp)}
+
                 # [L, ...] -> [vp, pp, Lc, ...]: spec grows (vp, pipe, Lc) dims
                 pspecs["layers"] = jax.tree_util.tree_map(
                     lambda s: P(None, s[0], None, *tuple(s)[1:]), pspecs["layers"],
@@ -336,12 +350,17 @@ class Trainer:
         ema_cfg = (
             EMAConfig.from_config(ema_block) if ema_block.get("enable") else None
         )
-        opt_state = init_opt_state(params, policy, ema=ema_cfg is not None)
+        abstract_params = jax.eval_shape(param_builder, init_key)
+        if trainable is None and lora_block:
+            # path-derived 0/1 scalars; reuses the one abstract trace
+            from neuronx_distributed_training_tpu.peft import trainable_mask
+
+            trainable = trainable_mask(abstract_params)
         # full ZeRO-1 including the embedding: the pipeline embed hooks use the
         # one-hot matmul form (ops.linear.apply_embedding via_matmul) so no
         # gather-transpose scatter reaches the partitioner under manual pipe
         ospecs = opt_state_specs(
-            params, pspecs, mesh, zero1=zero1, policy=policy,
+            abstract_params, pspecs, mesh, zero1=zero1, policy=policy,
             ema=ema_cfg is not None,
         )
 
@@ -361,16 +380,44 @@ class Trainer:
                                donate=ema_cfg is None)
         eval_fn = jax.jit(make_eval_step(eval_loss_fn)) if val_data_module else None
 
-        # shard initial state onto the mesh
+        # materialize sharded-at-birth: jit with out_shardings creates every
+        # leaf directly on its own devices — no full-model host/single-device
+        # copy ever exists (cf. reference meta_device_init)
         import functools
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         ns = functools.partial(NamedSharding, mesh)
-        put = lambda tree, specs: jax.device_put(
-            tree, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+        shardings = lambda specs: jax.tree_util.tree_map(
+            ns, specs, is_leaf=lambda x: isinstance(x, P)
         )
-        params = put(params, pspecs)
-        opt_state = put(opt_state, ospecs)
+        with mesh, shd.use_mesh(mesh):
+            params = jax.jit(
+                param_builder, out_shardings=shardings(pspecs)
+            )(init_key)
+
+        # warm start BEFORE the optimizer state is built: fp32 master weights
+        # (and the EMA tree) must seed from the RESTORED params — the update
+        # derives new params from opt_state["master"], so a master copied
+        # from random init would silently void the warm start on step 1
+        # (reference weight_init_only + resume_from_checkpoint,
+        # nlp_overrides.py:541-568)
+        warm_path = (cfg.get("exp_manager", {}) or {}).get("resume_from_checkpoint")
+        if warm_path and bool((cfg.get("model", {}) or {}).get("weight_init_only")):
+            warm_ck = Checkpointer(CheckpointConfig(dir=str(warm_path)))
+            try:
+                params = warm_ck.restore_params_only(
+                    params, mesh=mesh, param_specs=pspecs
+                )
+            finally:
+                warm_ck.close()
+            logger.info("warm start: params restored from %s", warm_path)
+
+        with mesh, shd.use_mesh(mesh):
+            opt_state = jax.jit(
+                functools.partial(init_opt_state, policy=policy,
+                                  ema=ema_cfg is not None),
+                out_shardings=shardings(ospecs),
+            )(params)
 
         # opt-in sharding sanity gate (SURVEY.md §5.2 "jit-time shape/sharding
         # assertions" — the TPU-native analogue of the reference's
@@ -384,20 +431,6 @@ class Trainer:
             assert_tree_sharding(params, pspecs, mesh)
             assert_tree_sharding(opt_state, ospecs, mesh)
             logger.info("debug.validate_sharding: params + opt state verified")
-
-        # warm start: weights only, no optimizer/loop state (the reference's
-        # weight_init_only + resume_from_checkpoint SFT/DPO recipe,
-        # nlp_overrides.py:541-568)
-        warm_path = (cfg.get("exp_manager", {}) or {}).get("resume_from_checkpoint")
-        if warm_path and bool((cfg.get("model", {}) or {}).get("weight_init_only")):
-            warm_ck = Checkpointer(CheckpointConfig(dir=str(warm_path)))
-            try:
-                params = warm_ck.restore_params_only(
-                    params, mesh=mesh, param_specs=pspecs
-                )
-            finally:
-                warm_ck.close()
-            logger.info("warm start: params restored from %s", warm_path)
 
         if data_module is None:
             # deferred ``data.synthetic: true`` (build_data_module had no vocab

@@ -404,3 +404,31 @@ def test_pp_val_batch_size_mismatch_raises(tmp_path, devices8):
     with pytest.raises(ValueError, match="global_batch_size"):
         Trainer.from_config(cfg, val_data_module=val_dm,
                             enable_checkpointing=False)
+
+
+def test_warm_start_seeds_master_weights(tmp_path, devices8):
+    """weight_init_only warm start under a master-weights regime (bf16SR):
+    opt_state['master'] must copy the RESTORED weights, not random init —
+    otherwise step 1 derives new params from the random master and silently
+    voids the warm start."""
+    cfg1 = tiny_cfg(tmp_path, max_steps=2)
+    cfg1["precision"] = {"type": "bf16SR"}
+    t1 = Trainer.from_config(load_config(dict(cfg1)))
+    t1.fit()
+    ckpt_dir = tmp_path / "exp" / "tiny" / "version_0" / "checkpoints"
+    trained_w = np.asarray(t1.params["layers"]["attn"]["qkv"]["w"],
+                           dtype=np.float32)
+
+    cfg2 = tiny_cfg(tmp_path, max_steps=1,
+                    exp_manager={"exp_dir": str(tmp_path / "exp2"),
+                                 "resume_from_checkpoint": str(ckpt_dir)})
+    cfg2["precision"] = {"type": "bf16SR"}
+    cfg2["model"]["weight_init_only"] = True
+    cfg2["seed"] = 99  # different init — a leaked random master would differ
+    t2 = Trainer.from_config(load_config(dict(cfg2)), enable_checkpointing=False)
+    restored_w = np.asarray(t2.params["layers"]["attn"]["qkv"]["w"],
+                            dtype=np.float32)
+    np.testing.assert_allclose(restored_w, trained_w, rtol=0, atol=0)
+    assert "master" in t2.opt_state, "bf16SR must carry fp32 master weights"
+    master_w = np.asarray(t2.opt_state["master"]["layers"]["attn"]["qkv"]["w"])
+    np.testing.assert_allclose(master_w, trained_w, rtol=0, atol=0)
