@@ -262,6 +262,10 @@ def main() -> None:
                     help="flash tile override (per-chip tuning sweep)")
     ap.add_argument("--block-kv", type=int, default=None)
     ap.add_argument("--regime", choices=["both", "mixed", "bf16"], default="both")
+    ap.add_argument("--remat", choices=["selective", "full", "none"],
+                    default="selective",
+                    help="activation-checkpoint granularity for the bench "
+                         "model (perf experiment knob)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a platform (cpu for local smoke runs)")
     args = ap.parse_args()
@@ -313,6 +317,12 @@ def main() -> None:
         policy, bpp = regimes[name]
         cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp,
                           args.block_q, args.block_kv)
+        if args.remat != "selective":
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, activations_checkpoint_granularity=(
+                    None if args.remat == "none" else args.remat))
         log(f"bench[{name}]: device={dev.device_kind} layers={cfg.num_layers} "
             f"seq={seq} mbs={args.mbs} attn={cfg.attention_impl}")
         # OOM backoff: fewer layers, then tied embed+head (halves the 1.05B
