@@ -233,6 +233,56 @@ def _ring_local(q, k, v, *, axis_name, cp, causal, window, block_kv):
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)  # [b, sq, h, d]
 
 
+def in_manual_region() -> bool:
+    """True inside a ``shard_map`` Manual region (e.g. the pipeline body).
+
+    A nested inner ``shard_map`` mishandles data that VARIES over the outer
+    manual axis under ``check_vma=False``: the forward is right but the
+    backward sums cotangents across the outer axis (verified: pipe-varying
+    inputs through a nested ring produce corrupted dq/dk/dv while loss stays
+    exact).  CP attention therefore must NOT open an inner shard_map there —
+    callers switch to the pure-GSPMD blockwise body instead.
+    """
+    cur = jax.sharding.get_abstract_mesh()
+    return bool(getattr(cur, "axis_names", None)
+                and any("Manual" in str(t) for t in cur.axis_types))
+
+
+def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
+                              block_kv: int = 512):
+    """Memory-bounded global attention with NO explicit collectives.
+
+    The online-softmax block scan of ``_chunk_update`` applied to the FULL
+    (GSPMD-global) sequence: XLA partitions the seq-sharded operands and
+    inserts the context-axis collectives itself, so this is correct under any
+    enclosing manual region (the nested-shard_map backward hazard above).
+    It is the CP-attention body used under pipeline parallelism — the
+    explicit ppermute ring (faster comm schedule) is the pp == 1 fast path.
+    Score memory stays O(sq x block_kv) like the ring body.
+    """
+    b, s, h, d = q.shape
+    # largest divisor of s <= block_kv: _chunk_update's non-divisible
+    # fallback collapses to ONE block, which at the full global sequence
+    # would be an O(s^2) score tensor — exactly what this body must bound
+    bkv = max(1, min(block_kv, s))
+    while s % bkv:
+        bkv -= 1
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    compute = jax.checkpoint(functools.partial(
+        _chunk_update, scale=1.0 / (d ** 0.5), causal=causal,
+        window=sliding_window, block_kv=bkv,
+    ))
+    o, m, l = compute(qh, kh, vh, o0, m0, l0, 0, 0)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.where(m > NEG_INF / 2, o / l_safe, 0.0)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
 def _cp_prep(q, k, v, *, axis_name, mesh, tag):
     """Shared CP-attention scaffolding: resolve mesh/cp/tp, validate head
     divisibility, apply the GQA KV replication for ``tp > kv_heads`` (the
@@ -242,8 +292,9 @@ def _cp_prep(q, k, v, *, axis_name, mesh, tag):
     XLA's job), and build the shard_map spec.
 
     Returns ``None`` when cp == 1 (caller falls back to core attention), else
-    ``(mesh, cp, tp, k, v, q_spec, h_l, kvh_l)`` with per-TP-rank local head
-    counts.
+    ``(mesh, cp, tp, k, v, q_spec, h_l, kvh_l)``.  When cp > 1 inside a
+    Manual region (``in_manual_region()``) callers must NOT open the inner
+    shard_map — ring routes to ``blockwise_gspmd_attention``, zigzag raises.
     """
     mesh = mesh or shd.active_mesh()
     cp = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
@@ -302,6 +353,16 @@ def ring_attention(
         # (core_attention applies it inside the causal mask; flash_attention
         # drops it when causal=False) — match that contract here
         sliding_window = None
+    mesh_ = mesh or shd.active_mesh()
+    cp_ = int(mesh_.shape.get(axis_name, 1)) if mesh_ is not None else 1
+    if cp_ > 1 and in_manual_region():
+        # pipeline body (Manual over pipe): the GSPMD blockwise body — the
+        # reference's TP x PP x CP flagship layout
+        # (hf_llama3_70B_CP_config.yaml) runs through here
+        return blockwise_gspmd_attention(
+            q, k, v, causal=causal, sliding_window=sliding_window,
+            block_kv=block_kv,
+        )
     prep = _cp_prep(q, k, v, axis_name=axis_name, mesh=mesh, tag="ring attention")
     if prep is None:
         from neuronx_distributed_training_tpu.ops.attention import core_attention
@@ -511,6 +572,13 @@ def zigzag_ring_attention(
         from neuronx_distributed_training_tpu.ops.attention import core_attention
 
         return core_attention(q, k, v, causal=True)
+    if in_manual_region():
+        # the zig-zag layout's mask cases assume the explicit ring; inside a
+        # manual region the trainer's pp guard should have fired already
+        raise ValueError(
+            "zigzag ring cannot run inside a manual (pipeline) region; use "
+            "fusions.ring_attention for pp + cp configs"
+        )
     mesh, cp, tp, k, v, q_spec, h_l, kvh_l = prep
 
     s, d = q.shape[1], q.shape[3]

@@ -91,6 +91,18 @@ def ulysses_attention(
         from neuronx_distributed_training_tpu.ops.attention import core_attention
 
         return core_attention(q, k, v, causal=causal, sliding_window=sliding_window)
+    from neuronx_distributed_training_tpu.parallel.ring_attention import (
+        blockwise_gspmd_attention,
+        in_manual_region,
+    )
+
+    if in_manual_region():
+        # a nested shard_map corrupts backward for pipe-varying inputs (see
+        # ring_attention.in_manual_region) — under pipeline parallelism CP
+        # attention runs the GSPMD blockwise body instead
+        return blockwise_gspmd_attention(
+            q, k, v, causal=causal, sliding_window=sliding_window
+        )
 
     h, kvh = q.shape[2], k.shape[2]
     tp = int(mesh.shape.get("model", 1))

@@ -665,3 +665,61 @@ def test_mixtral_interleaved_vpp_matches_reference(devices8):
     np.testing.assert_allclose(
         np.asarray(grads["embed"]["embedding"]),
         np.asarray(ref_g["embed"]["embedding"]), rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_cp_attention_under_pp_matches(devices8, impl):
+    """CP attention under pipeline parallelism (the reference's 70B CP
+    flagship layout, hf_llama3_70B_CP_config: TP=32 PP=8 CP=2).  Inside the
+    pipe-Manual pipeline body a nested shard_map corrupts backward for
+    pipe-varying inputs, so ring/ulysses route to the GSPMD blockwise body —
+    loss AND grads must match the unsharded core-attention reference."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, num_layers=2, attention_impl=impl, context_parallel=True,
+        max_position_embeddings=64,
+    )
+    ref_cfg = dataclasses.replace(CFG, num_layers=2, max_position_embeddings=64)
+    params = llama.init_params(jax.random.PRNGKey(0), ref_cfg, FP32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 64), 0, CFG.vocab_size)
+    mbs = {"input_ids": ids, "labels": ids}
+    nm = ids.shape[0]
+
+    def ref(p, m):
+        def body(acc, mb):
+            return acc + llama.forward(p, mb, ref_cfg, FP32)[0], None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), m)
+        return total / nm
+
+    ref_l, ref_g = jax.value_and_grad(ref)(params, mbs)
+
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2,
+                                 context_parallel_size=2,
+                                 tensor_model_parallel_size=2))
+    embed_fn, stage_fn, loss_fn = llama.pipeline_hooks(cfg, FP32)
+
+    def pl(p, m):
+        return pipeline_loss(
+            p, p["layers"], m,
+            embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, mesh=mesh,
+        )
+
+    specs = llama.param_specs(cfg, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    sh_mbs = jax.device_put(mbs, ns(P(None, ("data", "expert"), "context")))
+    with mesh, shd.use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(pl, argnums=0))(sh_params, sh_mbs)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    for path in (("embed", "embedding"), ("layers", "attn", "qkv", "w")):
+        g, rg = grads, ref_g
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {path}",
+        )
