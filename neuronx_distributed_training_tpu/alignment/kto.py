@@ -1,0 +1,75 @@
+"""KTO: unpaired preference alignment (arXiv:2402.01306).
+
+Not in the reference (its alignment surface is SFT/DPO/ORPO,
+``model_alignment_data_module.py:123-146``) — a TPU-native extension using
+the same machinery as DPO: a frozen-policy reference pass before training
+(``base_dpo.py:23-66`` pattern) and per-sequence completion log-probs from
+the vocab-parallel helper.
+
+Batch contract (``KTODataModule``): ``input_ids`` (prompt+completion),
+``loss_mask`` (1 on completion tokens), ``kto_labels`` ([b], 1 desirable /
+0 undesirable) plus the precomputed ``reference_logps`` column.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import numpy as np
+
+from neuronx_distributed_training_tpu.alignment.dpo import _call_forward
+from neuronx_distributed_training_tpu.alignment.losses import (
+    kto_loss,
+    sequence_logprobs,
+)
+
+ForwardLogits = Callable[..., Any]
+
+
+def compute_reference_logprobs_kto(
+    params: Any,
+    batches: Iterable[dict[str, np.ndarray]],
+    forward_logits: ForwardLogits,
+) -> dict[str, np.ndarray]:
+    """Frozen-policy completion log-probs over the train set -> one column."""
+
+    @jax.jit
+    def one(params, batch):
+        logits, _reg = _call_forward(
+            forward_logits, params, {"input_ids": batch["input_ids"]}
+        )
+        return sequence_logprobs(
+            logits, batch["input_ids"], batch.get("loss_mask")
+        )
+
+    out = []
+    for batch in batches:
+        out.append(np.asarray(one(params, batch)))
+    return {"reference_logps": np.concatenate(out)}
+
+
+def make_kto_loss_fn(
+    forward_logits: ForwardLogits,
+    *,
+    beta: float = 0.1,
+    desirable_weight: float = 1.0,
+    undesirable_weight: float = 1.0,
+):
+    """Trainer-compatible loss_fn for KTO batches."""
+
+    def loss_fn(params, batch, key):
+        logits, reg = _call_forward(
+            forward_logits, params, {"input_ids": batch["input_ids"]}, key
+        )
+        logps = sequence_logprobs(
+            logits, batch["input_ids"], batch.get("loss_mask")
+        )
+        loss, metrics = kto_loss(
+            logps, batch["reference_logps"], batch["kto_labels"],
+            beta=beta, desirable_weight=desirable_weight,
+            undesirable_weight=undesirable_weight,
+        )
+        return loss + reg, metrics
+
+    return loss_fn

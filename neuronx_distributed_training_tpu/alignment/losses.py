@@ -94,3 +94,38 @@ def orpo_loss(
         "orpo_ratio": jnp.mean(ratio_term),
     }
     return loss, metrics
+
+
+def kto_loss(
+    policy_logps: jax.Array,  # [b] per-sequence completion log-probs
+    reference_logps: jax.Array,  # [b] frozen-policy logps (pre-fit pass)
+    labels: jax.Array,  # [b] 1.0 = desirable, 0.0 = undesirable
+    *,
+    beta: float = 0.1,
+    desirable_weight: float = 1.0,
+    undesirable_weight: float = 1.0,
+):
+    """KTO (Kahneman-Tversky Optimization, arXiv:2402.01306) for UNPAIRED
+    preference data — an extension beyond the reference's DPO/ORPO pair-only
+    surface.
+
+    Per-example reward ``r = beta * (logp_policy - logp_ref)``; the KL
+    baseline ``z0`` is the batch-mean reward clamped at 0 and detached (the
+    paper's shared-reference-point estimate).  Desirable examples maximize
+    ``sigmoid(r - z0)``, undesirable minimize via ``sigmoid(z0 - r)``, with
+    the lambda_D/lambda_U class weights for imbalanced feedback.
+    """
+    r = beta * (policy_logps - reference_logps)
+    z0 = jax.lax.stop_gradient(jnp.maximum(jnp.mean(r), 0.0))
+    des = labels > 0.5
+    value = jnp.where(des, jax.nn.sigmoid(r - z0), jax.nn.sigmoid(z0 - r))
+    w = jnp.where(des, desirable_weight, undesirable_weight)
+    loss = jnp.mean(w * (1.0 - value))
+    n_des = jnp.maximum(jnp.sum(des.astype(jnp.float32)), 1.0)
+    n_und = jnp.maximum(jnp.sum((~des).astype(jnp.float32)), 1.0)
+    metrics = {
+        "kto_kl": z0,
+        "rewards_desirable": jnp.sum(jnp.where(des, r, 0.0)) / n_des,
+        "rewards_undesirable": jnp.sum(jnp.where(des, 0.0, r)) / n_und,
+    }
+    return loss, metrics

@@ -26,6 +26,7 @@ from neuronx_distributed_training_tpu.data.loader import (
 )
 from neuronx_distributed_training_tpu.data.modules import (
     DPODataModule,
+    KTODataModule,
     MegatronDataModule,
     SFTDataModule,
 )
@@ -45,12 +46,12 @@ def alignment_strategy(cfg: Any) -> tuple[str, dict]:
         return "", {}
     if isinstance(blk, str):
         return blk.lower(), {}
-    for name in ("sft", "dpo", "orpo"):
+    for name in ("sft", "dpo", "orpo", "kto"):
         if name in blk:
             return name, dict(blk.get(name) or {})
     raise ValueError(
         f"model_alignment_strategy must be a string or contain one of "
-        f"sft/dpo/orpo, got keys {list(blk)}"
+        f"sft/dpo/orpo/kto, got keys {list(blk)}"
     )
 
 
@@ -133,11 +134,14 @@ def build_data_module(
             raise ValueError("SFT needs data.train_dir (jsonl/json/arrow)")
         return sft(train_dir), (sft(val_dir) if val_dir else None)
 
-    if strategy in ("dpo", "orpo"):
+    if strategy in ("dpo", "orpo", "kto"):
         tokenizer = build_tokenizer(data)
+        # kto: unpaired (prompt, completion, label) records — an extension
+        # beyond the reference's pair-only surface (see alignment/kto.py)
+        module_cls = KTODataModule if strategy == "kto" else DPODataModule
 
-        def dpo(path):
-            return DPODataModule(
+        def pref(path):
+            return module_cls(
                 path, tokenizer, seq, gbs, seed=seed,
                 max_prompt_length=strat_params.get("max_prompt_length"),
                 truncation_mode=str(strat_params.get("truncation_mode", "keep_start")),
@@ -145,7 +149,7 @@ def build_data_module(
 
         if not train_dir:
             raise ValueError(f"{strategy.upper()} needs data.train_dir (jsonl/json/arrow)")
-        return dpo(train_dir), (dpo(val_dir) if val_dir else None)
+        return pref(train_dir), (pref(val_dir) if val_dir else None)
 
     if data_prefix:
         # Megatron mmap pretraining (reference megatron/data_module.py:89-130);

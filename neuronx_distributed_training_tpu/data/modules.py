@@ -208,6 +208,29 @@ class SFTDataModule(DataModule):
         return {k: v[idx] for k, v in self.arrays.items()}
 
 
+def _encode_prompt_completion(encode, eos, prompt, completion, seq_length,
+                              max_prompt_length, truncation_mode):
+    """(ids, labels) for one prompt+completion pair: prompt-length cap +
+    overlong truncation (reference ``model_alignment_data_module.py``
+    max_prompt_length / truncation_mode keep_start|keep_end) + prompt-masked
+    labels.  Shared by the DPO and KTO modules so the truncation policy
+    can't drift between them."""
+    p_toks = list(encode(prompt))
+    if max_prompt_length and len(p_toks) > int(max_prompt_length):
+        m = int(max_prompt_length)
+        p_toks = p_toks[:m] if truncation_mode == "keep_start" else p_toks[-m:]
+    c_toks = list(encode(completion)) + [eos]
+    if len(p_toks) + len(c_toks) > seq_length:
+        keep = seq_length - len(c_toks)
+        if keep <= 0:
+            p_toks, c_toks = [], c_toks[-seq_length:]
+        elif truncation_mode == "keep_end":
+            p_toks = p_toks[-keep:]
+        else:
+            p_toks = p_toks[:keep]
+    return mask_prompt_labels(p_toks, c_toks)
+
+
 class DPODataModule(DataModule):
     """DPO/ORPO preference data: chosen/rejected pairs, prompt left-pad
     convention (reference ``PaddedDPODataset``, ``PaddedDataset.py:60-103``).
@@ -238,23 +261,10 @@ class DPODataModule(DataModule):
         for side in ("chosen", "rejected"):
             ids_list, lbl_list = [], []
             for r in records:
-                p_toks = list(encode(r["prompt"]))
-                # prompt-length cap + overlong-pair truncation (reference
-                # model_alignment_data_module.py max_prompt_length /
-                # truncation_mode keep_start|keep_end)
-                if max_prompt_length and len(p_toks) > int(max_prompt_length):
-                    m = int(max_prompt_length)
-                    p_toks = p_toks[:m] if truncation_mode == "keep_start" else p_toks[-m:]
-                c_toks = list(encode(r[side])) + [eos]
-                if len(p_toks) + len(c_toks) > seq_length:
-                    keep = seq_length - len(c_toks)
-                    if keep <= 0:
-                        p_toks, c_toks = [], c_toks[-seq_length:]
-                    elif truncation_mode == "keep_end":
-                        p_toks = p_toks[-keep:]
-                    else:
-                        p_toks = p_toks[:keep]
-                ids, lbl = mask_prompt_labels(p_toks, c_toks)
+                ids, lbl = _encode_prompt_completion(
+                    encode, eos, r["prompt"], r[side], seq_length,
+                    max_prompt_length, truncation_mode,
+                )
                 ids_list.append(ids)
                 lbl_list.append(lbl)
             padded = pad_sequences(ids_list, seq_length, pad_id, label_lists=lbl_list)
@@ -280,5 +290,80 @@ class DPODataModule(DataModule):
 
     def global_batches(self):
         # DPO batches bypass causal-LM label derivation
+        for idx in self.sampler:
+            yield self.fetch_rows(idx)
+
+
+class KTODataModule(DataModule):
+    """KTO unpaired preference data: single (prompt, completion, label)
+    records (arXiv:2402.01306) — an extension beyond the reference's
+    DPO/ORPO pair surface, reusing the same tokenize/pad machinery.
+
+    Records need ``prompt``, ``completion`` and a boolean-ish ``label``
+    (1/true = desirable).  After construction, call
+    ``attach_reference_logprobs`` with the pre-fit pass output
+    (``alignment.kto.compute_reference_logprobs_kto``).
+    """
+
+    def __init__(
+        self,
+        records: Sequence[dict[str, Any]] | str | Path,
+        tokenizer: Any,
+        seq_length: int,
+        global_batch_size: int,
+        *,
+        pad_id: int = 0,
+        max_prompt_length: Optional[int] = None,
+        truncation_mode: str = "keep_start",
+        **kw: Any,
+    ):
+        if isinstance(records, (str, Path)):
+            records = load_alignment_records(records)
+        encode = tokenizer.encode if hasattr(tokenizer, "encode") else tokenizer
+        eos = getattr(tokenizer, "eos_token_id", 0) or 0
+
+        ids_list, lbl_list, kto_labels = [], [], []
+        for r in records:
+            ids, lbl = _encode_prompt_completion(
+                encode, eos, r["prompt"], r["completion"], seq_length,
+                max_prompt_length, truncation_mode,
+            )
+            ids_list.append(ids)
+            lbl_list.append(lbl)
+            if "label" in r:
+                label = r["label"]
+            elif "desirable" in r:
+                label = r["desirable"]
+            else:
+                # a missing label must be loud: defaulting silently trains
+                # every record as desirable and the objective degenerates
+                raise KeyError(
+                    f"KTO record missing 'label' (or 'desirable') key: "
+                    f"{sorted(r)}"
+                )
+            kto_labels.append(1.0 if label else 0.0)
+        padded = pad_sequences(ids_list, seq_length, pad_id, label_lists=lbl_list)
+        self.arrays = {
+            "input_ids": np.asarray(padded["input_ids"]),
+            "loss_mask": np.asarray(padded["loss_mask"]),
+            "kto_labels": np.asarray(kto_labels, np.float32),
+        }
+        super().__init__(
+            len(records), global_batch_size, shuffle=kw.pop("shuffle", True),
+            input_names=tuple(self.arrays), **kw,
+        )
+
+    def attach_reference_logprobs(self, columns: dict[str, np.ndarray]) -> None:
+        for k, v in columns.items():
+            if len(v) != len(self.arrays["input_ids"]):
+                raise ValueError(f"column {k} length {len(v)} != dataset size")
+            self.arrays[k] = np.asarray(v, np.float32)
+        self.input_names = tuple(self.arrays)
+
+    def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+    def global_batches(self):
+        # KTO batches bypass causal-LM label derivation
         for idx in self.sampler:
             yield self.fetch_rows(idx)

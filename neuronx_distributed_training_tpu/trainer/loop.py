@@ -146,7 +146,7 @@ class Trainer:
         # DPO/ORPO swap the loss for the preference objective; DPO's pre-fit
         # reference-logprob pass runs in fit() (reference base_dpo.py:23-66),
         # ORPO needs no reference model (reference base_orpo.py:26-46)
-        if alignment in ("dpo", "orpo"):
+        if alignment in ("dpo", "orpo", "kto"):
             dpo_cfg = dict((cfg.get("model", {}) or {}).get(alignment, {}) or {})
             forward_logits = _forward_logits_for(model_cfg, policy)
 
@@ -156,6 +156,17 @@ class Trainer:
                 from neuronx_distributed_training_tpu.alignment.dpo import make_dpo_loss_fn
 
                 loss_fn = make_dpo_loss_fn(forward_logits, beta=beta)
+            elif alignment == "kto":
+                # unpaired preference (extension; see alignment/kto.py)
+                from neuronx_distributed_training_tpu.alignment.kto import make_kto_loss_fn
+
+                loss_fn = make_kto_loss_fn(
+                    forward_logits, beta=beta,
+                    desirable_weight=float(
+                        align_params.get("desirable_weight", 1.0)),
+                    undesirable_weight=float(
+                        align_params.get("undesirable_weight", 1.0)),
+                )
             else:
                 from neuronx_distributed_training_tpu.alignment.orpo import make_orpo_loss_fn
 
@@ -233,6 +244,12 @@ class Trainer:
                 stage_layer_slice(
                     int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
             nm = sched["num_microbatches"]
+            if alignment == "kto":
+                # without this guard the LM pipeline path below would replace
+                # the KTO loss and silently train a causal-LM objective
+                raise NotImplementedError(
+                    "KTO + pipeline parallelism not supported yet"
+                )
             if alignment in ("dpo", "orpo"):
                 # preference losses pipeline via the concatenated forward
                 # (reference base_dpo.py:68-88 runs chosen+rejected through
@@ -451,10 +468,26 @@ class Trainer:
             checkpointer = Checkpointer(ck_cfg)
 
         pre_fit = None
-        if alignment == "dpo":
+        if alignment in ("dpo", "kto"):
+            if alignment == "dpo":
+                from neuronx_distributed_training_tpu.alignment.dpo import (
+                    compute_reference_logprobs as _ref_pass,
+                )
+
+                _marker, _sidecar_name = (
+                    "reference_chosen_logps", "dpo_reference_logps.npz")
+            else:
+                from neuronx_distributed_training_tpu.alignment.kto import (
+                    compute_reference_logprobs_kto as _ref_pass,
+                )
+
+                _marker, _sidecar_name = (
+                    "reference_logps", "kto_reference_logps.npz")
+
             def pre_fit(trainer: "Trainer") -> None:
                 """Frozen-policy reference-logprob pass + column attach
-                (reference base_dpo.py:23-66 on_train_start).
+                (reference base_dpo.py:23-66 on_train_start; same protocol
+                for the KTO extension).
 
                 Runs BEFORE checkpoint resume (fit() ordering): the reference
                 logps must come from the frozen INITIAL policy, and at that
@@ -464,24 +497,20 @@ class Trainer:
                 dm = trainer.data_module
                 if not hasattr(dm, "attach_reference_logprobs"):
                     return  # caller supplied reference columns already
-                if "reference_chosen_logps" in getattr(dm, "arrays", {}):
+                if _marker in getattr(dm, "arrays", {}):
                     return
                 import os
 
                 sidecar = None
                 if trainer.checkpointer is not None:
                     sidecar = os.path.join(
-                        str(trainer.checkpointer.config.dir), "dpo_reference_logps.npz"
+                        str(trainer.checkpointer.config.dir), _sidecar_name
                     )
                     if os.path.exists(sidecar):
                         loaded = np.load(sidecar)
                         dm.attach_reference_logprobs({k: loaded[k] for k in loaded.files})
-                        logger.info("DPO reference logps restored from %s", sidecar)
+                        logger.info("reference logps restored from %s", sidecar)
                         return
-                from neuronx_distributed_training_tpu.alignment.dpo import (
-                    compute_reference_logprobs,
-                )
-
                 n = dm.sampler.total_samples
                 order = np.arange(n)
                 bs = min(trainer.data_module.global_batch_size, n)
@@ -504,11 +533,11 @@ class Trainer:
                     ref_params = dict(trainer.params)
                     ref_params["layers"] = from_interleaved(
                         trainer.params["layers"])
-                cols = compute_reference_logprobs(ref_params, batches, forward_logits)
+                cols = _ref_pass(ref_params, batches, forward_logits)
                 # trailing partial batch (if any) computed on the remainder
                 if n % bs:
                     rem = {k: v[order[n - (n % bs):]] for k, v in dm.arrays.items()}
-                    extra = compute_reference_logprobs(ref_params, [rem], forward_logits)
+                    extra = _ref_pass(ref_params, [rem], forward_logits)
                     cols = {k: np.concatenate([cols[k], extra[k]]) for k in cols}
                 dm.attach_reference_logprobs(cols)
                 if sidecar is not None:

@@ -162,3 +162,44 @@ class TestORPO:
         loss_bad, _ = orpo_loss(rejected, chosen, nll, beta=0.5)
         assert float(loss_good) < float(loss_bad)
         assert float(m["orpo_log_odds"]) > 0
+
+
+class TestKTO:
+    """KTO (unpaired preference, arXiv:2402.01306) — an extension beyond the
+    reference's DPO/ORPO pair-only surface."""
+
+    def test_kto_prefers_desirable(self):
+        from neuronx_distributed_training_tpu.alignment.losses import kto_loss
+
+        ref = jnp.zeros((4,))
+        labels = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        # policy already agrees with the labels -> lower loss
+        good = jnp.asarray([2.0, 2.0, -2.0, -2.0])
+        bad = jnp.asarray([-2.0, -2.0, 2.0, 2.0])
+        l_good, m = kto_loss(good, ref, labels, beta=0.5)
+        l_bad, _ = kto_loss(bad, ref, labels, beta=0.5)
+        assert float(l_good) < float(l_bad)
+        assert float(m["rewards_desirable"]) > float(m["rewards_undesirable"])
+
+    def test_kto_gradient_directions(self):
+        from neuronx_distributed_training_tpu.alignment.losses import kto_loss
+
+        ref = jnp.zeros((2,))
+        labels = jnp.asarray([1.0, 0.0])
+
+        def loss(p):
+            return kto_loss(p, ref, labels, beta=0.5)[0]
+
+        g = jax.grad(loss)(jnp.zeros((2,)))
+        assert float(g[0]) < 0  # desirable logp pushed UP
+        assert float(g[1]) > 0  # undesirable logp pushed DOWN
+
+    def test_class_weights(self):
+        from neuronx_distributed_training_tpu.alignment.losses import kto_loss
+
+        ref = jnp.zeros((2,))
+        labels = jnp.asarray([1.0, 0.0])
+        p = jnp.asarray([-1.0, 1.0])  # both wrong
+        l1, _ = kto_loss(p, ref, labels, beta=0.5, undesirable_weight=1.0)
+        l2, _ = kto_loss(p, ref, labels, beta=0.5, undesirable_weight=2.0)
+        assert float(l2) > float(l1)

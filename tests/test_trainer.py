@@ -432,3 +432,43 @@ def test_warm_start_seeds_master_weights(tmp_path, devices8):
     assert "master" in t2.opt_state, "bf16SR must carry fp32 master weights"
     master_w = np.asarray(t2.opt_state["master"]["layers"]["attn"]["qkv"]["w"])
     np.testing.assert_allclose(master_w, trained_w, rtol=0, atol=0)
+
+
+def test_kto_trainer_end_to_end(tmp_path, devices8):
+    """model_alignment_strategy: kto — unpaired (prompt, completion, label)
+    records; frozen-reference pass attaches reference_logps; one fit() epoch
+    produces a finite loss and KTO metrics."""
+    from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    cfg = tiny_cfg(tmp_path, max_steps=2)
+    cfg["model_alignment_strategy"] = {"kto": {"kl_beta": 0.2}}
+    records = [{"prompt": f"q{i}", "completion": "yes good" if i % 2 else "no",
+                "label": bool(i % 2)} for i in range(16)]
+    dm = KTODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
+    t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+    m = t.fit()
+    assert np.isfinite(m["loss"])
+    assert "reference_logps" in dm.arrays
+    assert "kto_kl" in m
+
+
+def test_kto_pp_guard(tmp_path, devices8):
+    from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    cfg = tiny_cfg(tmp_path, max_steps=1)
+    cfg["model_alignment_strategy"] = "kto"
+    cfg["distributed_strategy"] = {"pipeline_model_parallel_size": 2}
+    records = [{"prompt": "q", "completion": "a", "label": True}] * 8
+    dm = KTODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
+    with pytest.raises(NotImplementedError, match="KTO"):
+        Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
