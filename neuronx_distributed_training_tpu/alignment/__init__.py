@@ -1,4 +1,4 @@
-"""Model alignment — SFT / DPO / ORPO recipes."""
+"""Model alignment — SFT / DPO / ORPO / KTO recipes."""
 
 from neuronx_distributed_training_tpu.alignment.losses import (  # noqa: F401
     dpo_loss,
@@ -12,3 +12,8 @@ from neuronx_distributed_training_tpu.alignment.dpo import (  # noqa: F401
 from neuronx_distributed_training_tpu.alignment.orpo import (  # noqa: F401
     make_orpo_loss_fn,
 )
+from neuronx_distributed_training_tpu.alignment.kto import (  # noqa: F401
+    compute_reference_logprobs_kto,
+    make_kto_loss_fn,
+)
+from neuronx_distributed_training_tpu.alignment.losses import kto_loss  # noqa: F401

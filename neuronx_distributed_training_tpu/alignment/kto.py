@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_training_tpu.alignment.dpo import _call_forward
@@ -73,3 +74,34 @@ def make_kto_loss_fn(
         return loss + reg, metrics
 
     return loss_fn
+
+
+def kto_pipeline_hooks(embed_fn, stage_fn, head_fn, *, beta: float = 0.1,
+                       desirable_weight: float = 1.0,
+                       undesirable_weight: float = 1.0):
+    """Wrap a model's pipeline hooks for KTO under pipeline parallelism.
+
+    Unlike DPO/ORPO there is no chosen/rejected concatenation — KTO batches
+    are single sequences — so the embed/stage hooks pass through untouched
+    and only the loss hook changes: per-sequence completion log-probs from
+    the final hidden states, then the KTO objective against the precomputed
+    ``reference_logps`` column.  Returns the standard ``(loss_sum, denom)``
+    contract (example-count weighted so microbatch accumulation averages
+    over examples; the batch-mean KL baseline is per-MICRObatch, a finer
+    estimate than the global batch — same detached-baseline semantics).
+    """
+
+    def loss2(params, y, mb):
+        logits = head_fn(params, y)
+        logps = sequence_logprobs(
+            logits, mb["input_ids"], mb.get("loss_mask")
+        )
+        loss, _metrics = kto_loss(
+            logps, mb["reference_logps"], mb["kto_labels"],
+            beta=beta, desirable_weight=desirable_weight,
+            undesirable_weight=undesirable_weight,
+        )
+        b = mb["input_ids"].shape[0]
+        return loss * b, jnp.asarray(b, jnp.float32)
+
+    return embed_fn, stage_fn, loss2

@@ -244,13 +244,7 @@ class Trainer:
                 stage_layer_slice(
                     int(getattr(model_cfg, "num_layers", 0) or 0), pp, vp)
             nm = sched["num_microbatches"]
-            if alignment == "kto":
-                # without this guard the LM pipeline path below would replace
-                # the KTO loss and silently train a causal-LM objective
-                raise NotImplementedError(
-                    "KTO + pipeline parallelism not supported yet"
-                )
-            if alignment in ("dpo", "orpo"):
+            if alignment in ("dpo", "orpo", "kto"):
                 # preference losses pipeline via the concatenated forward
                 # (reference base_dpo.py:68-88 runs chosen+rejected through
                 # NxDPPModel as one doubled batch); every family pipelines —
@@ -303,9 +297,24 @@ class Trainer:
                     # computes logits without labels, so no aux term here
                     # (stage_aux stays — MoE stages return (x, aux) tuples)
                     hook_opts = dict(hook_opts, aux_inv_layers=0.0)
-                embed_fn, stage_fn, stage_loss_fn = preference_pipeline_hooks(
-                    base_embed, base_stage, head_fn, mode=alignment, beta=beta
-                )
+                if alignment == "kto":
+                    # single-sequence batches: embed/stage pass through, only
+                    # the loss hook changes (no chosen/rejected concat)
+                    from neuronx_distributed_training_tpu.alignment.kto import (
+                        kto_pipeline_hooks,
+                    )
+
+                    embed_fn, stage_fn, stage_loss_fn = kto_pipeline_hooks(
+                        base_embed, base_stage, head_fn, beta=beta,
+                        desirable_weight=float(
+                            align_params.get("desirable_weight", 1.0)),
+                        undesirable_weight=float(
+                            align_params.get("undesirable_weight", 1.0)),
+                    )
+                else:
+                    embed_fn, stage_fn, stage_loss_fn = preference_pipeline_hooks(
+                        base_embed, base_stage, head_fn, mode=alignment, beta=beta
+                    )
             else:
                 (embed_fn, stage_fn, stage_loss_fn), hook_opts = pipeline_hooks_for(
                     cfg, model_cfg, policy, shift_labels=shift_labels

@@ -457,7 +457,9 @@ def test_kto_trainer_end_to_end(tmp_path, devices8):
     assert "kto_kl" in m
 
 
-def test_kto_pp_guard(tmp_path, devices8):
+def test_kto_under_pp(tmp_path, devices8):
+    """KTO under pipeline parallelism: single-sequence batches through the
+    LM pipeline with the KTO loss hook (no chosen/rejected concat)."""
     from neuronx_distributed_training_tpu.data.modules import KTODataModule
 
     class CharTok:
@@ -466,9 +468,13 @@ def test_kto_pp_guard(tmp_path, devices8):
             return [3 + (ord(c) % 60) for c in s]
 
     cfg = tiny_cfg(tmp_path, max_steps=1)
-    cfg["model_alignment_strategy"] = "kto"
+    cfg["model_alignment_strategy"] = {"kto": {"kl_beta": 0.2}}
     cfg["distributed_strategy"] = {"pipeline_model_parallel_size": 2}
-    records = [{"prompt": "q", "completion": "a", "label": True}] * 8
+    cfg["model"]["num_layers"] = 4
+    records = [{"prompt": f"q{i}", "completion": "yes good" if i % 2 else "no",
+                "label": bool(i % 2)} for i in range(16)]
     dm = KTODataModule(records, CharTok(), seq_length=32, global_batch_size=8)
-    with pytest.raises(NotImplementedError, match="KTO"):
-        Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+    t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+    m = t.fit()
+    assert np.isfinite(m["loss"])
+    assert "reference_logps" in dm.arrays
