@@ -69,8 +69,11 @@ _PROBE_SRC = (
 )
 
 
-def acquire_device(retries: int = 3, probe_timeout_s: float = 180.0,
-                   delay_s: float = 30.0, platform: str | None = None):
+def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
+                   delay_s: float = 15.0, platform: str | None = None):
+    # worst-case probe budget ~3.6 min: must stay comfortably inside the
+    # driver's own bench timeout so a wedged chip yields the DIAGNOSTIC JSON
+    # (with last_measured evidence), never an rc=124 with no output
     """Get a usable JAX device without risking an indefinite in-process hang.
 
     The tunnelled TPU backend can hang or be transiently UNAVAILABLE (round-1
