@@ -84,6 +84,7 @@ class TestCollectiveCensus:
 
 
 class TestTrainStepCollectives:
+    @pytest.mark.slow  # 16 s full-train-step compile; keeps the fast tier < 5 min
     def test_tp_zero1_train_step_pattern(self, tp_mesh):
         """The compiled TP=2 + ZeRO-1 train step must contain reduction
         collectives (grad sync) and gather collectives (ZeRO-1 param
