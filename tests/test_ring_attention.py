@@ -277,3 +277,39 @@ def test_non_causal_window_is_ignored_like_core():
         )(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_cp_attention_pipe_varying_grads(devices8):
+    """Regression pin for the nested-shard_map backward hazard: CP attention
+    invoked inside a Manual region (the pipeline body) with inputs that VARY
+    over the manual axis must produce exact per-rank gradients.  The broken
+    design (an inner shard_map under check_vma=False) kept the forward exact
+    but summed cotangents across the outer axis — this test fails loudly if
+    that path is ever reintroduced."""
+    mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2,
+                                 context_parallel_size=2))
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 4, 16), jnp.float32)
+
+    def per_rank_ref(q):
+        total = 0.0
+        for r in range(2):
+            x = q * (r + 1)
+            total += jnp.sum(jnp.square(core_attention(x, x, x, causal=True)))
+        return total
+
+    ref_g = jax.grad(per_rank_ref)(q)
+
+    def piped(q):
+        def body(q):
+            r = jax.lax.axis_index("pipe").astype(q.dtype)
+            x = q * (r + 1.0)  # pipe-VARYING input, like wavefront activations
+            y = ring_attention(x, x, x, causal=True)
+            return jax.lax.psum(jnp.sum(jnp.square(y)), "pipe")
+
+        f = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                          axis_names={"pipe"}, check_vma=False)
+        return f(q)
+
+    with mesh, shd.use_mesh(mesh):
+        g = jax.jit(jax.grad(piped))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), atol=5e-4)
