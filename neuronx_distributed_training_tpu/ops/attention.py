@@ -138,11 +138,12 @@ def attention(
     one-time warning) if the requested kernel is unavailable, so reference
     configs with ``fusions.flash_attention: true`` still run.
 
-    ``attention_mask`` (padding) is only supported by the core path: the
-    Pallas flash kernel and the ring body skip masked blocks structurally, so
-    a padded batch falls back to core with a one-time warning.  Right-padded
-    batches under a causal mask don't need it — pads are never attended by
-    real tokens — so pretraining/packed-SFT never hits the fallback."""
+    ``attention_mask`` (padded KEYS, the HF contract) is supported in-kernel
+    by the flash, ring, ulysses, and core paths — padded SFT/DPO batches stay
+    on the O(seq)-memory kernels (the reference runs its NKI flash kernel on
+    ``attention_mask`` batches too, ``llama_model.py:94-101``).  Only
+    zigzag_ring rejects it: the batch is zig-zag permuted and a key-position
+    mask would be wrong in that layout."""
     if attention_mask is not None and impl == "zigzag_ring":
         # a core fallback would be WRONG here (the batch is zig-zag permuted
         # and core's causal mask assumes contiguous order) — so raise
@@ -150,9 +151,6 @@ def attention(
             "zigzag_ring does not support attention_mask (padded batches); "
             "use fusions.ring_attention"
         )
-    if attention_mask is not None and impl in ("flash", "ring", "ulysses"):
-        _warn_fallback(f"{impl}+attention_mask")
-        impl = "core"
     if impl == "flash":
         try:
             from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
@@ -161,7 +159,8 @@ def attention(
         else:
             return flash_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window,
-                q_offset=q_offset, block_q=block_q, block_kv=block_kv,
+                q_offset=q_offset, attention_mask=attention_mask,
+                block_q=block_q, block_kv=block_kv,
             )
     if impl == "ring":
         try:
@@ -175,7 +174,8 @@ def attention(
                     "an explicit q_offset is not meaningful here"
                 )
             return ring_attention(
-                q, k, v, causal=causal, sliding_window=sliding_window
+                q, k, v, causal=causal, sliding_window=sliding_window,
+                attention_mask=attention_mask,
             )
     if impl == "ulysses":
         try:
@@ -189,7 +189,8 @@ def attention(
                     "an explicit q_offset is not meaningful here"
                 )
             return ulysses_attention(
-                q, k, v, causal=causal, sliding_window=sliding_window
+                q, k, v, causal=causal, sliding_window=sliding_window,
+                attention_mask=attention_mask,
             )
     if impl == "zigzag_ring":
         from neuronx_distributed_training_tpu.parallel.ring_attention import (

@@ -104,9 +104,14 @@ def _inner_mask(bq, bkv, qi, ki, causal, window, q_offset):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, window, q_offset, bq, bkv, num_kv,
+    q_ref, k_ref, v_ref, *refs,
+    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked,
 ):
+    if masked:
+        kvm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        kvm_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -116,7 +121,12 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_visible(qi, ki, bq, bkv, causal, window, q_offset))
+    vis = _visible(qi, ki, bq, bkv, causal, window, q_offset)
+    if kvm_ref is not None:
+        # skip kv blocks that are entirely padding (long pad tails cost 0 MXU)
+        vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+
+    @pl.when(vis)
     def _compute():
         q = q_ref[0, 0]  # [bq, d]
         k = k_ref[0, 0]  # [bkv, d]
@@ -128,6 +138,10 @@ def _fwd_kernel(
         mask = _inner_mask(bq, bkv, qi, ki, causal, window, q_offset)
         if mask is not None:
             s = s + mask
+        if kvm_ref is not None:
+            # padded KEYS masked (the HF attention_mask contract) — [1, bkv]
+            # broadcasts over query rows
+            s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -157,8 +171,9 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], SUBLANES))
 
 
-def _fwd_pallas(q, k, v, *, sm_scale, causal, window, q_offset, bq, bkv, interpret):
-    """q [b, nh, sq, d]; k/v [b, nkv, skv, d] -> (o [b, nh, sq, d], lse [b, nh, sq, SUBLANES])."""
+def _fwd_pallas(q, k, v, kvm, *, sm_scale, causal, window, q_offset, bq, bkv, interpret):
+    """q [b, nh, sq, d]; k/v [b, nkv, skv, d]; kvm None or [b, skv] int32
+    (1 = real key) -> (o [b, nh, sq, d], lse [b, nh, sq, SUBLANES])."""
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = nh // nkv
@@ -168,16 +183,21 @@ def _fwd_pallas(q, k, v, *, sm_scale, causal, window, q_offset, bq, bkv, interpr
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, window=window, q_offset=q_offset,
-        bq=bq, bkv=bkv, num_kv=num_kv,
+        bq=bq, bkv=bkv, num_kv=num_kv, masked=kvm is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+    ]
+    in_arrays = [q, k, v]
+    if kvm is not None:
+        in_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
+        in_arrays.append(kvm)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -195,7 +215,7 @@ def _fwd_pallas(q, k, v, *, sm_scale, causal, window, q_offset, bq, bkv, interpr
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*in_arrays)
     return o, lse
 
 
@@ -205,9 +225,14 @@ def _fwd_pallas(q, k, v, *, sm_scale, causal, window, q_offset, bq, bkv, interpr
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
-    *, sm_scale, causal, window, q_offset, bq, bkv, num_kv,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked,
 ):
+    if masked:
+        kvm_ref, dq_ref, acc_scr = refs
+    else:
+        dq_ref, acc_scr = refs
+        kvm_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -215,7 +240,11 @@ def _dq_kernel(
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_visible(qi, ki, bq, bkv, causal, window, q_offset))
+    vis = _visible(qi, ki, bq, bkv, causal, window, q_offset)
+    if kvm_ref is not None:
+        vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+
+    @pl.when(vis)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -229,6 +258,10 @@ def _dq_kernel(
         mask = _inner_mask(bq, bkv, qi, ki, causal, window, q_offset)
         if mask is not None:
             s = s + mask
+        if kvm_ref is not None:
+            # re-apply the key padding mask — p must be 0 on padded keys or
+            # dq leaks gradient through them
+            s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
         # rows with no visible key anywhere carry lse = NEG_INF; exp(s - lse)
         # would be garbage there, so zero them (matches fwd's 0 output)
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
@@ -250,10 +283,14 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, sm_scale, causal, window, q_offset, bq, bkv, num_q, group,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    sm_scale, causal, window, q_offset, bq, bkv, num_q, group, masked,
 ):
+    if masked:
+        kvm_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+        kvm_ref = None
     ki = pl.program_id(2)
     g = pl.program_id(3)
     qi = pl.program_id(4)
@@ -263,7 +300,11 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_visible(qi, ki, bq, bkv, causal, window, q_offset))
+    vis = _visible(qi, ki, bq, bkv, causal, window, q_offset)
+    if kvm_ref is not None:
+        vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+
+    @pl.when(vis)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -277,6 +318,8 @@ def _dkv_kernel(
         mask = _inner_mask(bq, bkv, qi, ki, causal, window, q_offset)
         if mask is not None:
             s = s + mask
+        if kvm_ref is not None:
+            s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
@@ -301,7 +344,7 @@ def _dkv_kernel(
 
 def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpret,
                 dlse=None):
-    q, k, v, o, lse = res  # q [b, nh, sq, d]; k/v [b, nkv, skv, d]
+    q, k, v, kvm, o, lse = res  # q [b, nh, sq, d]; k/v [b, nkv, skv, d]
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = nh // nkv
@@ -316,20 +359,23 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
     delta = jnp.broadcast_to(delta[..., None], (b, nh, sq, SUBLANES))
 
     common = dict(sm_scale=sm_scale, causal=causal, window=window, q_offset=q_offset,
-                  bq=bq, bkv=bkv)
-    in_arrays = (q, k, v, g, lse, delta)
+                  bq=bq, bkv=bkv, masked=kvm is not None)
+    in_arrays = (q, k, v, g, lse, delta) + ((kvm,) if kvm is not None else ())
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+    ]
+    if kvm is not None:
+        dq_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, num_kv=num_kv, **common),
         grid=(b, nh, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -342,17 +388,20 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
     # dk/dv per KV-head: the q-head group is a sequential grid dim, accumulated
     # in the fp32 VMEM scratch — 1x HBM writes and no bf16 intermediate in the
     # GQA group sum.
+    dkv_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
+        pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
+    ]
+    if kvm is not None:
+        dkv_specs.append(pl.BlockSpec((1, bkv), lambda bi, kh, ki, g, qi: (bi, ki)))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, num_q=num_q, group=group, **common),
         grid=(b, nkv, num_kv, group, num_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
-            pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
-            pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
             pl.BlockSpec((1, 1, bkv, d), lambda bi, kh, ki, g, qi: (bi, kh, ki, 0)),
@@ -379,30 +428,41 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
 )
-def _flash(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+def _flash(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
     o, _ = _fwd_pallas(
-        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
     return o
 
 
-def _flash_fwd(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+def _flash_fwd(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
     o, lse = _fwd_pallas(
-        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, kvm, o, lse)
+
+
+def _mask_cotangent(kvm):
+    """Zero cotangent for the (non-differentiable) int32 key mask: integer
+    primals carry ``float0`` tangents in JAX."""
+    if kvm is None:
+        return None
+    import numpy as np
+
+    return np.zeros(kvm.shape, dtype=jax.dtypes.float0)
 
 
 def _flash_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
     q = res[0]
-    return _bwd_pallas(
+    dq, dk, dv = _bwd_pallas(
         res, g, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
+    return dq, dk, dv, _mask_cotangent(res[3])
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -411,8 +471,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # -- lse-exposing variant (the ring-attention building block) ----------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
     """Like ``_flash`` but returns ``(o, lse)`` with lse differentiable.
 
     ``lse [b, nh, sq]`` is the per-row logsumexp of the (scaled, masked)
@@ -422,27 +482,28 @@ def _flash_lse(q, k, v, causal, window, q_offset, bq, bkv, interpret):
     lse cotangent into the kernel's delta operand.
     """
     o, lse = _fwd_pallas(
-        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
     return o, lse[..., 0]
 
 
-def _flash_lse_fwd(q, k, v, causal, window, q_offset, bq, bkv, interpret):
+def _flash_lse_fwd(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
     o, lse = _fwd_pallas(
-        q, k, v, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
+        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
-    return (o, lse[..., 0]), (q, k, v, o, lse)
+    return (o, lse[..., 0]), (q, k, v, kvm, o, lse)
 
 
 def _flash_lse_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
     do, dlse = g
     q = res[0]
-    return _bwd_pallas(
+    dq, dk, dv = _bwd_pallas(
         res, do, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret, dlse=dlse,
     )
+    return dq, dk, dv, _mask_cotangent(res[3])
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -456,6 +517,18 @@ def flash_tileable(sq: int, skv: int, d: int, nh: int, nkv: int,
     return _tileable(sq, skv, d, bq, bkv) and nh % nkv == 0
 
 
+def _prep_mask(attention_mask, b, skv):
+    """Normalize ``attention_mask`` [b, skv] (1 = real key) to int32 or None."""
+    if attention_mask is None:
+        return None
+    if attention_mask.shape != (b, skv):
+        raise ValueError(
+            f"attention_mask must be [batch, kv_len] = ({b}, {skv}); got "
+            f"{attention_mask.shape}"
+        )
+    return attention_mask.astype(jnp.int32)
+
+
 def flash_attention_with_lse(
     q: jax.Array,  # [b, sq, nh, d]
     k: jax.Array,  # [b, skv, nkv, d]
@@ -464,6 +537,7 @@ def flash_attention_with_lse(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     q_offset: int = 0,
+    attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = real key
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
@@ -489,7 +563,8 @@ def flash_attention_with_lse(
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o, lse = _flash_lse(qt, kt, vt, causal, sliding_window, q_offset, bq, bkv,
+    kvm = _prep_mask(attention_mask, b, skv)
+    o, lse = _flash_lse(qt, kt, vt, kvm, causal, sliding_window, q_offset, bq, bkv,
                         interpret)
     return jnp.swapaxes(o, 1, 2), lse
 
@@ -502,12 +577,16 @@ def flash_attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     q_offset: int = 0,
+    attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = real key
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention in the model's [b, s, h, d] layout.
 
+    ``attention_mask`` masks padded KEYS (the HF contract, reference
+    ``llama_model.py:94-101``) inside the kernel — padded SFT/DPO batches stay
+    on the flash path instead of falling back to the O(s^2) core attention.
     Falls back to ``core_attention`` when shapes don't tile (tiny test models,
     odd head dims) — the dispatch contract of ``ops.attention``.
     ``interpret`` defaults to True off-TPU so tests run on CPU.
@@ -518,15 +597,20 @@ def flash_attention(
         sliding_window = None  # window is causal-only, matching core_attention
     bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
     if not _tileable(sq, skv, d, bq, bkv) or nh % nkv != 0:
-        from neuronx_distributed_training_tpu.ops.attention import core_attention
+        from neuronx_distributed_training_tpu.ops.attention import (
+            core_attention,
+            padding_mask_bias,
+        )
 
         return core_attention(
-            q, k, v, causal=causal, q_offset=q_offset, sliding_window=sliding_window
+            q, k, v, causal=causal, q_offset=q_offset, sliding_window=sliding_window,
+            bias=(None if attention_mask is None else padding_mask_bias(attention_mask)),
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     qt = jnp.swapaxes(q, 1, 2)  # [b, nh, sq, d]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash(qt, kt, vt, causal, sliding_window, q_offset, bq, bkv, interpret)
+    kvm = _prep_mask(attention_mask, b, skv)
+    o = _flash(qt, kt, vt, kvm, causal, sliding_window, q_offset, bq, bkv, interpret)
     return jnp.swapaxes(o, 1, 2)

@@ -26,6 +26,7 @@ active mesh: batch over ``(data, expert)``, heads over ``model``, sequence over
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -37,14 +38,18 @@ from neuronx_distributed_training_tpu.parallel import sharding as shd
 
 NEG_INF = -1e30
 
+logger = logging.getLogger(__name__)
+_warned_bkv: set = set()
+
 
 def _block_update(qh, ks, vs, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
-                  causal, window):
+                  causal, window, kv_mask=None):
     """One online-softmax accumulation against a KV BLOCK (ks, vs).
 
     qh [b, h, sq, d]; ks/vs [b, h, bkv, d] (GQA heads already repeated);
     o_acc [b, h, sq, d]; m_acc/l_acc [b, h, sq, 1].  Offsets are traced
-    scalars (global positions of query row 0 / kv row 0).
+    scalars (global positions of query row 0 / kv row 0).  ``kv_mask``
+    [b, bkv] (1 = real key) masks padded keys.
     """
     s = jax.lax.dot_general(
         qh, ks, (((3,), (3,)), ((0, 1), (0, 1))), preferred_element_type=jnp.float32
@@ -58,6 +63,8 @@ def _block_update(qh, ks, vs, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
         # Mixtral-style sliding window on GLOBAL positions (reference
         # modeling_mixtral.py:145-148); composes with the ring offsets
         s = jnp.where(kv_pos > q_pos - window, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
     m_c = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_acc, m_c)
     alpha = jnp.exp(m_acc - m_new)  # rescale of previous partials
@@ -71,7 +78,7 @@ def _block_update(qh, ks, vs, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
 
 
 def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
-                  causal, window, block_kv):
+                  causal, window, block_kv, kv_mask=None):
     """Accumulate one ring chunk BLOCKWISE over its KV length.
 
     The fp32 score tensor is [b, h, sq, block_kv] per inner step instead of
@@ -80,7 +87,7 @@ def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
     the single-chip fast path, this is the ring body).
     q [b, h, sq, d]; kc/vc [b, kvh, skv, d] (un-repeated GQA heads — repeated
     here, inside the remat boundary, so the ring rotates and the scan carries
-    only kvh heads).
+    only kvh heads).  ``kv_mask`` [b, skv] (1 = real key) masks padded keys.
     """
     h, kvh = q.shape[1], kc.shape[1]
     if kvh != h:
@@ -94,14 +101,18 @@ def _chunk_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off, *, scale,
 
     if n_blocks == 1:
         return _block_update(q, kc, vc, o_acc, m_acc, l_acc, q_off, kv_off,
-                             scale=scale, causal=causal, window=window)
+                             scale=scale, causal=causal, window=window,
+                             kv_mask=kv_mask)
 
     def blk(carry, i):
         o, m, l = carry
         ks = jax.lax.dynamic_slice_in_dim(kc, i * bkv, bkv, axis=2)
         vs = jax.lax.dynamic_slice_in_dim(vc, i * bkv, bkv, axis=2)
+        ms = (None if kv_mask is None
+              else jax.lax.dynamic_slice_in_dim(kv_mask, i * bkv, bkv, axis=1))
         o, m, l = _block_update(q, ks, vs, o, m, l, q_off, kv_off + i * bkv,
-                                scale=scale, causal=causal, window=window)
+                                scale=scale, causal=causal, window=window,
+                                kv_mask=ms)
         return (o, m, l), None
 
     (o_acc, m_acc, l_acc), _ = jax.lax.scan(
@@ -131,10 +142,12 @@ def _merge_partial(o_acc, lse_acc, o_c, lse_c):
     return o_new, lse_new
 
 
-def _ring_local_flash(q, k, v, *, axis_name, cp, causal, window, interpret):
+def _ring_local_flash(q, k, v, kvm=None, *, axis_name, cp, causal, window,
+                      interpret):
     """Per-rank ring body fused with the Pallas flash kernel.
 
-    q [b, sq, h, d]; k/v [b, skv, kvh, d] -> o [b, sq, h, d].
+    q [b, sq, h, d]; k/v [b, skv, kvh, d]; kvm None or [b, skv] (local key
+    padding mask chunk, rotated with K/V) -> o [b, sq, h, d].
 
     The ring is unrolled over the (static) step index ``t`` so the kernel's
     block-masking offsets stay trace-time constants: at ``t == 0`` the held
@@ -155,25 +168,25 @@ def _ring_local_flash(q, k, v, *, axis_name, cp, causal, window, interpret):
 
     o_acc = jnp.zeros((b, h, sq, d), jnp.float32)
     lse_acc = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    kc, vc = k, v
+    kc, vc, mc = k, v, kvm
     for t in range(cp):
         if not causal:
             o_c, lse_c = flash_attention_with_lse(
-                q, kc, vc, causal=False, interpret=interpret
+                q, kc, vc, causal=False, attention_mask=mc, interpret=interpret
             )
         elif t == 0:
             o_c, lse_c = flash_attention_with_lse(
                 q, kc, vc, causal=True, sliding_window=window, q_offset=0,
-                interpret=interpret,
+                attention_mask=mc, interpret=interpret,
             )
         else:
             # past chunk: fully causally visible; only the sliding window (if
             # any) masks, with static relative offset t*sq
             o_c, lse_c = flash_attention_with_lse(
                 q, kc, vc, causal=False, sliding_window=window,
-                q_offset=t * sq, interpret=interpret,
+                q_offset=t * sq, attention_mask=mc, interpret=interpret,
             ) if window is not None else flash_attention_with_lse(
-                q, kc, vc, causal=False, interpret=interpret
+                q, kc, vc, causal=False, attention_mask=mc, interpret=interpret
             )
             lse_c = jnp.where(my >= t, lse_c, NEG_INF)
         o_acc, lse_acc = _merge_partial(
@@ -182,14 +195,17 @@ def _ring_local_flash(q, k, v, *, axis_name, cp, causal, window, interpret):
         if t < cp - 1:
             kc = jax.lax.ppermute(kc, axis_name, perm)
             vc = jax.lax.ppermute(vc, axis_name, perm)
+            if mc is not None:
+                mc = jax.lax.ppermute(mc, axis_name, perm)
     o = jnp.where(lse_acc[..., None] > NEG_INF / 2, o_acc, 0.0)
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
 
-def _ring_local(q, k, v, *, axis_name, cp, causal, window, block_kv):
+def _ring_local(q, k, v, kvm=None, *, axis_name, cp, causal, window, block_kv):
     """Per-rank ring attention body (runs inside shard_map).
 
-    q [b, sq, h, d]; k/v [b, skv, kvh, d] (local chunks) -> o [b, sq, h, d].
+    q [b, sq, h, d]; k/v [b, skv, kvh, d] (local chunks); kvm None or
+    [b, skv] (local key padding mask, rotated with K/V) -> o [b, sq, h, d].
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
@@ -213,19 +229,21 @@ def _ring_local(q, k, v, *, axis_name, cp, causal, window, block_kv):
     )
 
     def step(carry, t):
-        o_acc, m_acc, l_acc, kc, vc = carry
+        o_acc, m_acc, l_acc, kc, vc, mc = carry
         src = jax.lax.rem(my - t + cp, cp)  # rank whose chunk we currently hold
         o_acc, m_acc, l_acc = compute(
-            qh, kc, vc, o_acc, m_acc, l_acc, q_off, src * skv
+            qh, kc, vc, o_acc, m_acc, l_acc, q_off, src * skv, kv_mask=mc
         )
         # rotate KV around the ring (skipped result unused on last step, but
         # keeping it unconditional keeps the collective schedule uniform)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (o_acc, m_acc, l_acc, kc, vc), None
+        if mc is not None:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        return (o_acc, m_acc, l_acc, kc, vc, mc), None
 
-    (o_acc, m_acc, l_acc, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, kh, vh), jnp.arange(cp)
+    (o_acc, m_acc, l_acc, _, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, kh, vh, kvm), jnp.arange(cp)
     )
     # causal: every row sees at least itself at t=0, so l > 0; guard anyway
     l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
@@ -249,7 +267,7 @@ def in_manual_region() -> bool:
 
 
 def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
-                              block_kv: int = 512):
+                              block_kv: int = 512, attention_mask=None):
     """Memory-bounded global attention with NO explicit collectives.
 
     The online-softmax block scan of ``_chunk_update`` applied to the FULL
@@ -259,6 +277,7 @@ def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
     It is the CP-attention body used under pipeline parallelism — the
     explicit ppermute ring (faster comm schedule) is the pp == 1 fast path.
     Score memory stays O(sq x block_kv) like the ring body.
+    ``attention_mask`` [b, s] (1 = real key) masks padded keys in-scan.
     """
     b, s, h, d = q.shape
     # largest divisor of s <= block_kv: _chunk_update's non-divisible
@@ -267,6 +286,16 @@ def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
     bkv = max(1, min(block_kv, s))
     while s % bkv:
         bkv -= 1
+    if bkv * 8 < min(block_kv, s) and (s, block_kv) not in _warned_bkv:
+        # a non-smooth sequence length (e.g. prime s) degrades to a tiny bkv
+        # and an s/bkv-step scan with pathological compile/step time — make
+        # the cliff loud instead of silent (ADVICE r2), once per shape
+        _warned_bkv.add((s, block_kv))
+        logger.warning(
+            "blockwise_gspmd_attention: seq %d has no divisor near block_kv "
+            "%d (chose %d) — the %d-step scan will be slow; pad the sequence "
+            "to a smoother length", s, block_kv, bkv, s // bkv,
+        )
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -277,7 +306,8 @@ def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
         _chunk_update, scale=1.0 / (d ** 0.5), causal=causal,
         window=sliding_window, block_kv=bkv,
     ))
-    o, m, l = compute(qh, kh, vh, o0, m0, l0, 0, 0)
+    kvm = None if attention_mask is None else attention_mask.astype(jnp.int32)
+    o, m, l = compute(qh, kh, vh, o0, m0, l0, 0, 0, kv_mask=kvm)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o = jnp.where(m > NEG_INF / 2, o / l_safe, 0.0)
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
@@ -333,6 +363,7 @@ def ring_attention(
     axis_name: str = "context",
     mesh=None,
     block_kv: int = 512,
+    attention_mask: Optional[jax.Array] = None,  # [b, s] 1 = real key
 ) -> jax.Array:
     """Context-parallel ring attention over the active mesh.
 
@@ -361,13 +392,20 @@ def ring_attention(
         # (hf_llama3_70B_CP_config.yaml) runs through here
         return blockwise_gspmd_attention(
             q, k, v, causal=causal, sliding_window=sliding_window,
-            block_kv=block_kv,
+            block_kv=block_kv, attention_mask=attention_mask,
         )
     prep = _cp_prep(q, k, v, axis_name=axis_name, mesh=mesh, tag="ring attention")
     if prep is None:
-        from neuronx_distributed_training_tpu.ops.attention import core_attention
+        from neuronx_distributed_training_tpu.ops.attention import (
+            core_attention,
+            padding_mask_bias,
+        )
 
-        return core_attention(q, k, v, causal=causal, sliding_window=sliding_window)
+        return core_attention(
+            q, k, v, causal=causal, sliding_window=sliding_window,
+            bias=(None if attention_mask is None
+                  else padding_mask_bias(attention_mask)),
+        )
     mesh, cp, tp, k, v, q_spec, h_l, kvh_l = prep
 
     # fuse the Pallas flash kernel into the ring body when the local shapes
@@ -387,14 +425,18 @@ def ring_attention(
             _ring_local, axis_name=axis_name, cp=cp, causal=causal,
             window=sliding_window, block_kv=block_kv,
         )
+    extra_specs, extra_args = (), ()
+    if attention_mask is not None:
+        extra_specs = (P(DATA_AXES, "context"),)
+        extra_args = (attention_mask.astype(jnp.int32),)
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(q_spec, q_spec, q_spec),
+        in_specs=(q_spec, q_spec, q_spec) + extra_specs,
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, *extra_args)
 
 
 # ---------------------------------------------------------------------------

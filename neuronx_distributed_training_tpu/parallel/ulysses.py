@@ -39,18 +39,22 @@ from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
 from neuronx_distributed_training_tpu.parallel import sharding as shd
 
 
-def _ulysses_local(q, k, v, *, axis_name, causal, window, use_flash,
+def _ulysses_local(q, k, v, kvm=None, *, axis_name, causal, window, use_flash,
                    interpret=None):
     """Per-rank body (inside shard_map, manual over the whole mesh).
 
     q [b, sq, h_l, d]; k/v [b, sq, kvh_l, d] with sq = s/cp the local
     sequence chunk and h_l the rank-local head count (h_l % cp == 0,
-    kvh_l % cp == 0 — arranged by the wrapper).
+    kvh_l % cp == 0 — arranged by the wrapper).  ``kvm`` is the local
+    [b, sq] key padding mask chunk; attention runs over the FULL sequence
+    per rank, so the mask is all-gathered (bytes per token, once per layer).
     """
     # all-to-all #1: trade head shards for the full sequence
     qf = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kf = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vf = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    mf = (None if kvm is None
+          else jax.lax.all_gather(kvm, axis_name, axis=1, tiled=True))
     # full-sequence attention on h_l/cp local heads — plain causal, offset 0
     if use_flash:
         from neuronx_distributed_training_tpu.ops.flash_attention import (
@@ -58,11 +62,15 @@ def _ulysses_local(q, k, v, *, axis_name, causal, window, use_flash,
         )
 
         o = flash_attention(qf, kf, vf, causal=causal, sliding_window=window,
-                            interpret=interpret)
+                            attention_mask=mf, interpret=interpret)
     else:
-        from neuronx_distributed_training_tpu.ops.attention import core_attention
+        from neuronx_distributed_training_tpu.ops.attention import (
+            core_attention,
+            padding_mask_bias,
+        )
 
-        o = core_attention(qf, kf, vf, causal=causal, sliding_window=window)
+        o = core_attention(qf, kf, vf, causal=causal, sliding_window=window,
+                           bias=(None if mf is None else padding_mask_bias(mf)))
     # all-to-all #2: back to sequence-sharded, all heads local
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -76,6 +84,7 @@ def ulysses_attention(
     sliding_window: Optional[int] = None,
     axis_name: str = "context",
     mesh=None,
+    attention_mask: Optional[jax.Array] = None,  # [b, s] 1 = real key
 ) -> jax.Array:
     """All-to-all context-parallel attention over the active mesh.
 
@@ -88,9 +97,16 @@ def ulysses_attention(
     mesh = mesh or shd.active_mesh()
     cp = int(mesh.shape.get(axis_name, 1)) if mesh is not None else 1
     if cp == 1:
-        from neuronx_distributed_training_tpu.ops.attention import core_attention
+        from neuronx_distributed_training_tpu.ops.attention import (
+            core_attention,
+            padding_mask_bias,
+        )
 
-        return core_attention(q, k, v, causal=causal, sliding_window=sliding_window)
+        return core_attention(
+            q, k, v, causal=causal, sliding_window=sliding_window,
+            bias=(None if attention_mask is None
+                  else padding_mask_bias(attention_mask)),
+        )
     from neuronx_distributed_training_tpu.parallel.ring_attention import (
         blockwise_gspmd_attention,
         in_manual_region,
@@ -101,7 +117,8 @@ def ulysses_attention(
         # ring_attention.in_manual_region) — under pipeline parallelism CP
         # attention runs the GSPMD blockwise body instead
         return blockwise_gspmd_attention(
-            q, k, v, causal=causal, sliding_window=sliding_window
+            q, k, v, causal=causal, sliding_window=sliding_window,
+            attention_mask=attention_mask,
         )
 
     h, kvh = q.shape[2], k.shape[2]
@@ -138,11 +155,15 @@ def ulysses_attention(
         _ulysses_local, axis_name=axis_name, causal=causal,
         window=sliding_window, use_flash=use_flash,
     )
+    extra_specs, extra_args = (), ()
+    if attention_mask is not None:
+        extra_specs = (P(DATA_AXES, "context"),)
+        extra_args = (attention_mask.astype(jnp.int32),)
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec),
+        in_specs=(q_spec, kv_spec, kv_spec) + extra_specs,
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, *extra_args)

@@ -34,3 +34,16 @@ def cpu_mesh(devices8):
     from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
 
     return build_mesh(MeshConfig(tensor_model_parallel_size=2), devices=devices8)
+
+
+def ragged_right_pad_mask(b, s, valid_lens):
+    """[b, s] int32 attention_mask with row i real for its first valid_lens[i]
+    positions (the HF right-padding convention) — shared by the masked
+    flash/ring/ulysses parity tests."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    m = np.zeros((b, s), dtype=np.int32)
+    for i, n in enumerate(valid_lens):
+        m[i, :n] = 1
+    return jnp.asarray(m)

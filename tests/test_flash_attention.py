@@ -89,3 +89,77 @@ def test_flash_bf16_grad_tolerance():
         a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
         err = jnp.max(jnp.abs(a32 - b32)) / (jnp.max(jnp.abs(b32)) + 1e-9)
         assert err < 0.05, f"d{name} bf16 rel err {err}"
+
+
+def _pad_mask(b, skv, valid_lens):
+    from tests.conftest import ragged_right_pad_mask
+
+    return ragged_right_pad_mask(b, skv, valid_lens)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_masked_matches_core_fwd_and_grad(causal):
+    """Padded-batch (attention_mask) support inside the Pallas kernel: the
+    flash path with a key padding mask must match core attention with the
+    equivalent additive bias — fwd and all three grads (VERDICT r2 item 2)."""
+    from neuronx_distributed_training_tpu.ops.attention import padding_mask_bias
+
+    b, s = 2, 256
+    q, k, v = _make_qkv(jax.random.PRNGKey(7), b, s, s, 4, 2, 128)
+    mask = _pad_mask(b, s, [s - 37, 129])  # ragged right-padding
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, attention_mask=mask,
+            block_q=128, block_kv=128, interpret=True,
+        )
+        return jnp.sum(o * o)
+
+    def loss_core(q, k, v):
+        o = core_attention(q, k, v, causal=causal, bias=padding_mask_bias(mask))
+        return jnp.sum(o * o)
+
+    lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    lc, gc = jax.value_and_grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+    assert jnp.allclose(lf, lc, rtol=2e-4), (lf, lc)
+    for a, b_, name in zip(gf, gc, "qkv"):
+        err = jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9)
+        assert err < 2e-3, f"d{name} rel err {err}"
+
+
+def test_flash_masked_no_grad_leak_to_padded_keys():
+    """dk/dv on padded key positions must be exactly zero — the backward
+    kernels re-apply the padding mask when recomputing p."""
+    b, s, valid = 1, 256, 100
+    q, k, v = _make_qkv(jax.random.PRNGKey(8), b, s, s, 2, 2, 128)
+    mask = _pad_mask(b, s, [valid])
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, attention_mask=mask,
+                            block_q=128, block_kv=128, interpret=True)
+        return jnp.sum(o * o)
+
+    _, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert jnp.all(dk[:, valid:] == 0), "dk leaks into padded keys"
+    assert jnp.all(dv[:, valid:] == 0), "dv leaks into padded keys"
+
+
+def test_flash_masked_with_lse_matches_core():
+    """The lse-exposing variant (ring building block) honors the mask too."""
+    from neuronx_distributed_training_tpu.ops.attention import padding_mask_bias
+    from neuronx_distributed_training_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    b, s = 2, 256
+    q, k, v = _make_qkv(jax.random.PRNGKey(9), b, s, s, 2, 2, 128)
+    mask = _pad_mask(b, s, [200, 130])
+    o, lse = flash_attention_with_lse(
+        q, k, v, causal=True, attention_mask=mask,
+        block_q=128, block_kv=128, interpret=True,
+    )
+    ref = core_attention(q, k, v, causal=True, bias=padding_mask_bias(mask))
+    assert jnp.max(jnp.abs(o - ref)) < 1e-4
+    # lse finite on real rows, NEG_INF convention respected on any fully
+    # masked row (none here — row i always sees key i when i < valid)
+    assert jnp.all(jnp.isfinite(lse[:, :, :130]))

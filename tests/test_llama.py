@@ -322,3 +322,58 @@ class TestAttentionMask:
         loss_explicit, _ = llama_mod.forward(params, batch2, cfg, fp32)
         np.testing.assert_allclose(float(loss_masked), float(loss_explicit), rtol=1e-6)
         assert np.isfinite(float(loss_masked))
+
+
+    @pytest.mark.slow
+    def test_sft_masked_batch_stays_on_flash_path(self, monkeypatch):
+        """fusions.flash_attention + attention_mask must run the Pallas flash
+        kernel, not silently fall back to O(s^2) core attention (VERDICT r2
+        item 2; reference runs NKI flash on attention_mask SFT batches,
+        llama_model.py:94-101)."""
+        import dataclasses
+
+        from neuronx_distributed_training_tpu.models import llama as llama_mod
+        from neuronx_distributed_training_tpu.ops import flash_attention as fa
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                           softmax_dtype=jnp.float32)
+        # lane-aligned shapes so the kernel itself runs (head 128, seq 256)
+        cfg = llama_mod.LlamaConfig(
+            vocab_size=64, hidden_size=256, intermediate_size=512, num_layers=1,
+            num_attention_heads=2, num_kv_heads=2,
+            max_position_embeddings=256, attention_impl="flash",
+            flash_block_q=128, flash_block_kv=128,
+            activations_checkpoint_granularity=None,
+        )
+        assert fa.flash_tileable(256, 256, 128, 2, 2)
+        calls = []
+        real_flash = fa._flash_fwd
+
+        def spy_flash(*a, **kw):
+            calls.append(a[3] is not None)
+            return real_flash(*a, **kw)
+
+        monkeypatch.setattr(fa, "_flash_fwd", spy_flash)
+        fa._flash.defvjp(spy_flash, fa._flash_bwd)
+        try:
+            params = llama_mod.init_params(jax.random.PRNGKey(0), cfg, fp32)
+            ids = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 3, 64)
+            mask = jnp.ones((2, 256), jnp.int32).at[0, 200:].set(0)
+            batch = {"input_ids": ids, "labels": ids, "attention_mask": mask,
+                     "loss_mask": mask.astype(jnp.float32)}
+
+            loss, grads = jax.value_and_grad(
+                lambda p: llama_mod.forward(p, batch, cfg, fp32)[0]
+            )(params)
+            assert np.isfinite(float(loss))
+            assert calls and all(calls), (
+                f"flash kernel not taken (or mask dropped): {calls}"
+            )
+            # numerics: must match the core path with the same mask
+            core_cfg = dataclasses.replace(cfg, attention_impl="core")
+            loss_core = llama_mod.forward(params, batch, core_cfg, fp32)[0]
+            np.testing.assert_allclose(float(loss), float(loss_core),
+                                       rtol=5e-5)
+        finally:
+            fa._flash.defvjp(real_flash, fa._flash_bwd)

@@ -369,3 +369,78 @@ class TestBlockwiseGspmd:
         g = jax.jit(jax.grad(loss_b, argnums=(0, 1, 2)))(q, k, v)
         for a, r in zip(g, ref_g):
             np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=5e-4)
+
+
+class TestRingMasked:
+    """attention_mask (padded batches) stays on the ring path (VERDICT r2)."""
+
+    def _mask(self, b, s, valid):
+        from tests.conftest import ragged_right_pad_mask
+
+        return ragged_right_pad_mask(b, s, valid)
+
+    def _ref(self, q, k, v, mask, causal=True):
+        from neuronx_distributed_training_tpu.ops.attention import (
+            padding_mask_bias,
+        )
+
+        return core_attention(q, k, v, causal=causal,
+                              bias=padding_mask_bias(mask))
+
+    def test_masked_matches_core(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(40))
+        mask = self._mask(2, 64, [50, 33])
+        ref = self._ref(q, k, v, mask)
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            out = jax.jit(lambda *a: ring_attention(
+                *a[:3], causal=True, attention_mask=a[3]))(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_masked_grads_match_core(self, cp_mesh):
+        q, k, v = make_qkv(jax.random.PRNGKey(41))
+        mask = self._mask(2, 64, [48, 21])
+
+        def loss_ring(q, k, v):
+            o = ring_attention(q, k, v, causal=True, attention_mask=mask)
+            return jnp.sum(o * o)
+
+        def loss_core(q, k, v):
+            return jnp.sum(self._ref(q, k, v, mask) ** 2)
+
+        with cp_mesh, shd.use_mesh(cp_mesh):
+            gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gc = jax.grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gr, gc, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5,
+                err_msg=f"d{name} mismatch under mask",
+            )
+
+    def test_masked_flash_ring_path(self, cp2_mesh=None):
+        # lane-aligned shapes so the flash-fused ring body runs with the mask
+        mesh = build_mesh(MeshConfig(context_parallel_size=2))
+        q, k, v = make_qkv(jax.random.PRNGKey(42), b=4, s=512, h=2, d=128)
+        mask = self._mask(4, 512, [300, 512, 129, 77])
+        ref = self._ref(q, k, v, mask)
+        from neuronx_distributed_training_tpu.ops.flash_attention import (
+            flash_tileable,
+        )
+
+        assert flash_tileable(256, 256, 128, 2, 2)  # flash body is active
+        with mesh, shd.use_mesh(mesh):
+            out = jax.jit(lambda *a: ring_attention(
+                *a[:3], causal=True, attention_mask=a[3]))(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_masked_blockwise_gspmd(self):
+        from neuronx_distributed_training_tpu.parallel.ring_attention import (
+            blockwise_gspmd_attention,
+        )
+
+        q, k, v = make_qkv(jax.random.PRNGKey(43))
+        mask = self._mask(2, 64, [40, 64])
+        ref = self._ref(q, k, v, mask)
+        out = blockwise_gspmd_attention(q, k, v, causal=True, block_kv=16,
+                                        attention_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -149,3 +149,24 @@ class TestUlyssesNumerics:
         with cp_mesh, shd.use_mesh(cp_mesh):
             out = jax.jit(lambda *a: attention(*a, impl="ulysses"))(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_masked_matches_core(devices8=None):
+    """attention_mask stays on the ulysses path (all-gathered per rank)."""
+    from neuronx_distributed_training_tpu.ops.attention import padding_mask_bias
+    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from neuronx_distributed_training_tpu.parallel import sharding as shd
+    import numpy as np
+
+    mesh = build_mesh(MeshConfig(context_parallel_size=4))
+    q = jax.random.normal(jax.random.PRNGKey(60), (2, 64, 4, 16))
+    k = jax.random.normal(jax.random.PRNGKey(61), (2, 64, 4, 16))
+    v = jax.random.normal(jax.random.PRNGKey(62), (2, 64, 4, 16))
+    from tests.conftest import ragged_right_pad_mask
+
+    mask = ragged_right_pad_mask(2, 64, [50, 30])
+    ref = core_attention(q, k, v, causal=True, bias=padding_mask_bias(mask))
+    with mesh, shd.use_mesh(mesh):
+        out = jax.jit(lambda *a: ulysses_attention(
+            *a[:3], causal=True, attention_mask=a[3]))(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
