@@ -148,15 +148,21 @@ def _set_path(tree: dict, dotted: str, value: Any) -> None:
 
 
 def validate_config(cfg: ConfigDict) -> None:
-    """Config validation mirroring the reference's checks
-    (``megatron_base_model.py:71-129``, ``training_orchestrator.py:60-102``,
-    ``base.py:54-57``) plus basic schema sanity."""
+    """The central config-validation catalog: every unsupported combination is
+    rejected here, before any compilation, with a curated message — the
+    counterpart of the reference's ``_validate_and_override_config``
+    (``megatron_base_model.py:71-129``) plus its orchestrator checks
+    (``training_orchestrator.py:60-102``, ``base.py:54-57``).  Runtime code
+    keeps thin backstop guards, but a bad config should die HERE, not as an
+    opaque GSPMD partitioner error."""
     ds = cfg.get("distributed_strategy", {}) or {}
     data = cfg.get("data", {}) or {}
     model = cfg.get("model", {}) or {}
+    fusions = dict(model.get("fusions", {}) or {})
 
     tp = int(ds.get("tensor_model_parallel_size", 1))
     pp = int(ds.get("pipeline_model_parallel_size", 1))
+    cp = int(ds.get("context_parallel_size", 1))
     if ds.get("sequence_parallel") and tp == 1:
         raise ValueError("sequence_parallel requires tensor_model_parallel_size > 1")
     vp = ds.get("virtual_pipeline_model_parallel_size") or 1
@@ -173,11 +179,90 @@ def validate_config(cfg: ConfigDict) -> None:
     mbs = data.get("micro_batch_size")
     if gbs is not None and mbs is not None and int(gbs) % int(mbs) != 0:
         raise ValueError(f"global_batch_size {gbs} not divisible by micro_batch_size {mbs}")
+
+    # ---- MoE --------------------------------------------------------------
     moe = model.get("moe", {}) or {}
     if moe.get("dropless") and (moe.get("capacity_factor") or 0) > 0:
         # reference validates dropless implies no capacity factor
         # (training_orchestrator.py:60-102)
         raise ValueError("moe.dropless=True requires capacity_factor unset/0")
+    moe_freq = int(moe.get("moe_frequency", 1) or 1)
+    if moe_freq > 1 and n_layers is not None:
+        if int(n_layers) % moe_freq != 0:
+            raise ValueError(
+                f"num_layers={n_layers} must be a multiple of "
+                f"moe.moe_frequency={moe_freq} (whole MoE+dense groups)"
+            )
+        groups = int(n_layers) // moe_freq
+        if pp * int(vp) > 1 and groups % (pp * int(vp)) != 0:
+            raise ValueError(
+                f"num_layers {n_layers} / moe_frequency {moe_freq} = {groups} "
+                f"MoE+dense groups, not divisible by pp*vp = {pp}*{vp}: the "
+                f"pipeline slices whole groups per stage chunk"
+            )
+
+    # ---- context parallelism & attention kernels --------------------------
+    seq = data.get("seq_length")
+    zigzag = bool(fusions.get("zigzag_ring_attention"))
+    ulysses = bool(fusions.get("ulysses_attention"))
+    cp_aware = zigzag or ulysses or bool(fusions.get("ring_attention"))
+    if cp > 1 and not cp_aware:
+        raise ValueError(
+            f"context_parallel_size={cp} requires a context-parallel attention "
+            f"fusion: set fusions.ring_attention, fusions.ulysses_attention, "
+            f"or fusions.zigzag_ring_attention (flash_attention alone is "
+            f"single-chip and core attention would materialize the full "
+            f"O(seq^2) scores)"
+        )
+    if cp > 1 and seq is not None and int(seq) % cp != 0:
+        raise ValueError(
+            f"data.seq_length={seq} must be divisible by "
+            f"context_parallel_size={cp}"
+        )
+    if zigzag:
+        if pp > 1:
+            raise ValueError(
+                "zigzag_ring_attention is not supported under pipeline "
+                "parallelism; use fusions.ring_attention for pp + cp configs"
+            )
+        if model.get("sliding_window"):
+            raise ValueError(
+                "zigzag_ring_attention does not support sliding_window; use "
+                "fusions.ring_attention (contiguous layout) for windowed models"
+            )
+        if cp > 1 and seq is not None and int(seq) % (2 * cp) != 0:
+            raise ValueError(
+                f"zigzag_ring_attention needs data.seq_length={seq} divisible "
+                f"by 2*context_parallel_size = {2 * cp} (two half-chunks per "
+                f"rank)"
+            )
+    n_heads = model.get("num_attention_heads")
+    if ulysses and cp > 1 and n_heads is not None and int(n_heads) % (tp * cp) != 0:
+        raise ValueError(
+            f"ulysses_attention: num_attention_heads={n_heads} must be "
+            f"divisible by tp*cp = {tp}*{cp} (use ring_attention when cp "
+            f"exceeds the head budget)"
+        )
+
+    # ---- precision --------------------------------------------------------
+    prec = cfg.get("precision", {}) or {}
+    ptype = prec.get("type") if isinstance(prec, Mapping) else prec
+    known = ("mixed_precision", "mixed_precisionsr", "mixed", "bf16sr",
+             "bf16", "autocast", "fp32", "fp32_paramsonly", "manual")
+    if ptype is not None and str(ptype).lower() not in known:
+        raise ValueError(
+            f"unknown precision.type {ptype!r}; supported regimes: "
+            f"mixed_precision, bf16SR, autocast, fp32, manual"
+        )
+
+    # ---- model alignment --------------------------------------------------
+    align = model.get("model_alignment_strategy", {}) or {}
+    chosen = [k for k in ("dpo", "orpo", "kto", "sft") if k in align]
+    if len(chosen) > 1:
+        raise ValueError(
+            f"model_alignment_strategy must name exactly one of "
+            f"sft/dpo/orpo/kto, got {chosen}"
+        )
 
 
 def batch_schedule(cfg: ConfigDict, n_devices: int) -> dict[str, int]:

@@ -392,9 +392,14 @@ class Trainer:
 
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
         lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
+        exp_block = dict(cfg.get("exp_manager", {}) or {})
         step_fn = make_train_step(
             loss_fn, opt_cfg, lr_schedule, policy,
             num_microbatches=num_micro_in_step,
+            # reference log_parameter_norm / log_gradient_norm
+            # (base.py:397-452): per-step norms in the metrics dict -> loggers
+            log_param_norm=bool(exp_block.get("log_parameter_norm", False)),
+            log_gradient_norm=bool(exp_block.get("log_gradient_norm", False)),
             trainable_mask=trainable,
             ema_cfg=ema_cfg,
         )

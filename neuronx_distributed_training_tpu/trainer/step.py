@@ -53,6 +53,7 @@ def make_train_step(
     *,
     num_microbatches: int = 1,
     log_param_norm: bool = False,
+    log_gradient_norm: bool = False,
     trainable_mask: Any = None,  # peft.lora.trainable_mask for LoRA freeze
     ema_cfg: Any = None,  # optim.adamw.EMAConfig; state must carry an "ema" tree
 ) -> Callable:
@@ -118,6 +119,11 @@ def make_train_step(
             # all-reduced norm — here a plain global norm (params are one
             # global pytree under GSPMD).
             metrics["param_norm"] = global_norm(new_params)
+        if log_gradient_norm:
+            # reference log_gradient_norm (base.py:397-452): the pre-clip
+            # grad norm under the reference's metric name (grad_norm is
+            # always logged; this adds the explicit parity alias)
+            metrics["gradient_norm"] = opt_metrics["grad_norm"]
         return new_params, new_opt_state, metrics
 
     return train_step

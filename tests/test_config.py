@@ -143,3 +143,102 @@ def test_all_shipped_configs_load_and_build():
         # specs build without touching devices
         specs = specs_fn()
         assert "layers" in specs, path
+
+
+class TestValidationCatalog:
+    """The central unsupported-combination catalog (reference
+    megatron_base_model.py:71-129) — every rejection carries a curated,
+    actionable message and fires at load time, before any compilation."""
+
+    def _base(self, **over):
+        cfg = {
+            "distributed_strategy": {"tensor_model_parallel_size": 1},
+            "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                     "seq_length": 64},
+            "model": {"num_layers": 4, "num_attention_heads": 4},
+        }
+        for dotted, v in over.items():
+            cur = cfg
+            parts = dotted.split(".")
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = v
+        return cfg
+
+    def _expect(self, match, **over):
+        with pytest.raises(ValueError, match=match):
+            load_config(self._base(**over))
+
+    def test_sp_without_tp(self):
+        self._expect("sequence_parallel requires",
+                     **{"distributed_strategy.sequence_parallel": True})
+
+    def test_vp_without_pp(self):
+        self._expect("virtual pipeline requires",
+                     **{"distributed_strategy.virtual_pipeline_model_parallel_size": 2})
+
+    def test_layers_not_divisible_by_pp_vp(self):
+        self._expect("divide evenly into pp",
+                     **{"distributed_strategy.pipeline_model_parallel_size": 3})
+
+    def test_gbs_not_divisible_by_mbs(self):
+        self._expect("not divisible by micro_batch_size",
+                     **{"data.micro_batch_size": 3})
+
+    def test_moe_groups_vs_pp_vp(self):
+        self._expect("MoE\\+dense groups",
+                     **{"model.moe.moe_frequency": 2, "model.num_layers": 4,
+                        "distributed_strategy.pipeline_model_parallel_size": 4,
+                        "model.fusions.ring_attention": True})
+
+    def test_moe_frequency_must_divide_layers(self):
+        self._expect("multiple of\\s+moe.moe_frequency",
+                     **{"model.moe.moe_frequency": 3, "model.num_layers": 4})
+
+    def test_cp_without_cp_aware_attention(self):
+        self._expect("context-parallel attention",
+                     **{"distributed_strategy.context_parallel_size": 2,
+                        "model.fusions.flash_attention": True})
+
+    def test_cp_seq_divisibility(self):
+        self._expect("divisible by\\s+context_parallel_size",
+                     **{"distributed_strategy.context_parallel_size": 4,
+                        "model.fusions.ring_attention": True,
+                        "data.seq_length": 30})
+
+    def test_zigzag_under_pp(self):
+        self._expect("zigzag_ring_attention is not supported under pipeline",
+                     **{"model.fusions.zigzag_ring_attention": True,
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "model.num_layers": 4})
+
+    def test_zigzag_with_sliding_window(self):
+        self._expect("does not support sliding_window",
+                     **{"model.fusions.zigzag_ring_attention": True,
+                        "model.sliding_window": 1024})
+
+    def test_zigzag_seq_two_cp(self):
+        self._expect("divisible\\s+by 2\\*context_parallel_size",
+                     **{"model.fusions.zigzag_ring_attention": True,
+                        "distributed_strategy.context_parallel_size": 2,
+                        "data.seq_length": 34})
+
+    def test_ulysses_head_budget(self):
+        self._expect("head budget",
+                     **{"model.fusions.ulysses_attention": True,
+                        "distributed_strategy.context_parallel_size": 8,
+                        "model.num_attention_heads": 4})
+
+    def test_unknown_precision_regime(self):
+        self._expect("unknown precision.type",
+                     **{"precision.type": "fp8_who_knows"})
+
+    def test_two_alignment_strategies(self):
+        self._expect("exactly one",
+                     **{"model.model_alignment_strategy.dpo.beta": 0.1,
+                        "model.model_alignment_strategy.kto.beta": 0.1})
+
+    def test_moe_dropless_capacity_conflict(self):
+        self._expect("dropless",
+                     **{"model.moe.dropless": True,
+                        "model.moe.capacity_factor": 1.5})

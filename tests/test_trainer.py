@@ -478,3 +478,25 @@ def test_kto_under_pp(tmp_path, devices8):
     m = t.fit()
     assert np.isfinite(m["loss"])
     assert "reference_logps" in dm.arrays
+
+
+class TestNormLogging:
+    def test_param_and_gradient_norm_flags(self, tmp_path, devices8):
+        """exp_manager.log_parameter_norm / log_gradient_norm produce per-step
+        param_norm / gradient_norm in the logged metrics (reference
+        base.py:397-452) — VERDICT r2 item 4."""
+        cfg = tiny_cfg(tmp_path, max_steps=2)
+        cfg["exp_manager"]["log_parameter_norm"] = True
+        cfg["exp_manager"]["log_gradient_norm"] = True
+        metrics = train(cfg)
+        assert metrics["param_norm"] > 0
+        assert metrics["gradient_norm"] == metrics["grad_norm"]
+        exp_dir = tmp_path / "exp" / "tiny" / "version_0"
+        rec = json.loads(
+            (exp_dir / "metrics.jsonl").read_text().strip().splitlines()[-1]
+        )
+        assert rec["param_norm"] > 0 and "gradient_norm" in rec
+
+    def test_norms_off_by_default(self, tmp_path, devices8):
+        metrics = train(tiny_cfg(tmp_path, max_steps=1))
+        assert "param_norm" not in metrics
