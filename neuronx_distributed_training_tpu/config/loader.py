@@ -244,6 +244,19 @@ def validate_config(cfg: ConfigDict) -> None:
             f"exceeds the head budget)"
         )
 
+    # ---- megatron block layout -------------------------------------------
+    bt = model.get("transformer_block_type")
+    if bt is not None and bt not in ("pre_ln", "post_ln", "normformer", "gpt_j"):
+        raise ValueError(
+            f"unknown transformer_block_type {bt!r}; supported: pre_ln, "
+            f"post_ln, normformer, gpt_j (reference transformer.py:1567)"
+        )
+    if bt == "normformer" and model.get("moe"):
+        raise ValueError(
+            "normformer blocks are dense-only (the mid-MLP norm has no "
+            "expert equivalent); use pre_ln or post_ln with MoE"
+        )
+
     # ---- precision --------------------------------------------------------
     prec = cfg.get("precision", {}) or {}
     ptype = prec.get("type") if isinstance(prec, Mapping) else prec

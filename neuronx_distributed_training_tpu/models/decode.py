@@ -355,7 +355,8 @@ def prefill_gpt(params, input_ids, cfg, policy, *, max_len=None):
             return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
         x, (ck, cv) = jax.lax.scan(body, x, layer_stack)
-    h = gpt._apply_norm(cfg, params["final_norm"], x)
+    h = (x if cfg.transformer_block_type == "post_ln"
+         else gpt._apply_norm(cfg, params["final_norm"], x))
     return h, {"k": ck, "v": cv}
 
 
@@ -378,9 +379,9 @@ def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
         cos, sin = rope_ops.rope_cos_sin(pos[:, None], inv_freq, dtype=jnp.float32)
     layer_stack = policy.cast_to_compute(params["layers"])
 
-    def layer_step(lp, x, ck, cv):
-        residual = x
-        hidden = gpt._apply_norm(cfg, lp["input_norm"], x)
+    def attn_part(lp, hidden, ck, cv):
+        """Cached attention on a pre-normed (or raw, post_ln) input ->
+        (o_proj output, updated cache)."""
         qkv = linear_ops.apply_linear(lp["attn"]["qkv"], hidden)
         q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
         q = q.reshape(b, 1, nh, d)
@@ -400,11 +401,34 @@ def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
             q, k, v, ck, cv, pos, sliding_window=cfg.sliding_window,
             softmax_dtype=policy.softmax_dtype,
         )
-        x = residual + linear_ops.apply_linear(lp["attn"]["o"], out.astype(x.dtype))
-        residual = x
-        hidden = gpt._apply_norm(cfg, lp["post_attn_norm"], x)
-        hidden, _aux = gpt._mlp_block(cfg, lp["mlp"], hidden, policy)
-        return residual + hidden, ck, cv
+        return linear_ops.apply_linear(lp["attn"]["o"], out.astype(hidden.dtype)), ck, cv
+
+    def layer_step(lp, x, ck, cv):
+        # same four layouts as gpt._decoder_layer, with cached attention
+        bt = cfg.transformer_block_type
+        if bt == "gpt_j":
+            a, ck, cv = attn_part(lp, gpt._apply_norm(cfg, lp["input_norm"], x),
+                                  ck, cv)
+            m, _aux = gpt._mlp_block(
+                cfg, lp["mlp"], gpt._apply_norm(cfg, lp["post_attn_norm"], x),
+                policy)
+            return x + a + m, ck, cv
+        if bt == "post_ln":
+            a, ck, cv = attn_part(lp, x, ck, cv)
+            x = gpt._apply_norm(cfg, lp["input_norm"], x + a)
+            m, _aux = gpt._mlp_block(cfg, lp["mlp"], x, policy)
+            return gpt._apply_norm(cfg, lp["post_attn_norm"], x + m), ck, cv
+        a, ck, cv = attn_part(lp, gpt._apply_norm(cfg, lp["input_norm"], x),
+                              ck, cv)
+        if bt == "normformer":
+            a = gpt._apply_norm(cfg, lp["nf_attn_norm"], a)
+        x = x + a
+        m, _aux = gpt._mlp_block(
+            cfg, lp["mlp"], gpt._apply_norm(cfg, lp["post_attn_norm"], x),
+            policy,
+            mid_norm=lp.get("nf_mlp_norm") if bt == "normformer" else None,
+        )
+        return x + m, ck, cv
 
     if cfg.moe is not None and cfg.moe_frequency > 1:
         f = cfg.moe_frequency
@@ -437,7 +461,8 @@ def decode_step_gpt(params, cache, tokens, pos, cfg, policy):
             return x, (ck, cv)
 
         x, (ck, cv) = jax.lax.scan(body, x, (layer_stack, cache["k"], cache["v"]))
-    h = gpt._apply_norm(cfg, params["final_norm"], x)
+    h = (x if cfg.transformer_block_type == "post_ln"
+         else gpt._apply_norm(cfg, params["final_norm"], x))
     logits = gpt._logits_from_hidden(params, h, cfg, policy)
     return logits[:, 0], {"k": ck, "v": cv}
 

@@ -16,10 +16,17 @@ Functional re-design of the reference's Megatron model source
 - optional sliding-window attention; dropout (embedding/hidden) with explicit
   PRNG threading;
 - MoE layers (``NeuronSwitchMLP``, ``transformer.py:376-467``) via
-  ``ops.moe`` with top-k or sinkhorn routing.
+  ``ops.moe`` with top-k or sinkhorn routing;
+- transformer block layouts ``pre_ln`` (default) | ``post_ln`` | ``normformer``
+  | ``gpt_j`` (``transformer.py:1468-2084``) and optional tokentype
+  embeddings (``language_model.py:194-328``).
 
-Pre-LN transformer blocks (the reference's default ``pre_ln``); loss is the
-same vocab-parallel CE as Llama.
+Normformer deviation: the reference computes the mid-MLP LayerNorm
+per-TP-partition (width ``ffn/tp``, no cross-shard stats); here it is a true
+LayerNorm over the full ffn width — GSPMD inserts the reduction, and the
+numerics don't change with tp.
+
+Loss is the same vocab-parallel CE as Llama.
 """
 
 from __future__ import annotations
@@ -62,6 +69,11 @@ class GPTConfig:
     hidden_dropout: float = 0.0
     embedding_dropout: float = 0.0
     sliding_window: Optional[int] = None
+    # block layout: "pre_ln" | "post_ln" | "normformer" | "gpt_j"
+    # (reference transformer.py:1468-2084)
+    transformer_block_type: str = "pre_ln"
+    # tokentype (segment) embeddings; 0 = none (language_model.py:194-328)
+    num_tokentypes: int = 0
     share_embeddings_and_output_weights: bool = True  # Megatron default tying
     initializer_range: float = 0.02
     attention_impl: str = "core"
@@ -118,6 +130,8 @@ class GPTConfig:
             sliding_window=m.get(
                 "sliding_window_size", m.get("window_size", m.get("sliding_window"))
             ),
+            transformer_block_type=str(m.get("transformer_block_type", "pre_ln")),
+            num_tokentypes=int(m.get("num_tokentypes", 0) or 0),
             share_embeddings_and_output_weights=bool(
                 m.get("share_embeddings_and_output_weights", True)
             ),
@@ -136,10 +150,14 @@ class GPTConfig:
 # ---------------------------------------------------------------------------
 
 
-def _norm_init(cfg: GPTConfig, dtype):
+BLOCK_TYPES = ("pre_ln", "post_ln", "normformer", "gpt_j")
+
+
+def _norm_init(cfg: GPTConfig, dtype, width: Optional[int] = None):
+    width = width or cfg.hidden_size
     if cfg.normalization == "rmsnorm":
-        return norm_ops.init_rms_norm(cfg.hidden_size, dtype=dtype)[0]
-    return norm_ops.init_layer_norm(cfg.hidden_size, dtype=dtype)[0]
+        return norm_ops.init_rms_norm(width, dtype=dtype)[0]
+    return norm_ops.init_layer_norm(width, dtype=dtype)[0]
 
 
 def _apply_norm(cfg: GPTConfig, params, x):
@@ -155,10 +173,28 @@ def _init_layer(key: jax.Array, cfg: GPTConfig, dtype, *, moe_layer=None):
     nh, nkv = cfg.num_attention_heads, cfg.kv_heads
     std = cfg.initializer_range
     bias = cfg.bias
+    if cfg.transformer_block_type not in BLOCK_TYPES:
+        raise ValueError(
+            f"unknown transformer_block_type {cfg.transformer_block_type!r}; "
+            f"supported: {BLOCK_TYPES}"
+        )
+    if cfg.transformer_block_type == "normformer" and cfg.moe is not None:
+        raise ValueError(
+            "normformer blocks are dense-only (the mid-MLP norm has no "
+            "expert equivalent); use pre_ln or post_ln with MoE"
+        )
     p: dict[str, Any] = {
         "input_norm": _norm_init(cfg, dtype),
+        # every layout keeps both norms — gpt_j's parallel residual norms the
+        # attn branch with input_norm and the MLP branch with post_attn_norm
+        # (two independent parameter sets, reference transformer.py:1908-1914)
         "post_attn_norm": _norm_init(cfg, dtype),
     }
+    if cfg.transformer_block_type == "normformer":
+        # extra norms: after the attention output (h) and after the MLP
+        # activation (ffn width) — reference transformer.py normformer layout
+        p["nf_attn_norm"] = _norm_init(cfg, dtype)
+        p["nf_mlp_norm"] = _norm_init(cfg, dtype, width=cfg.ffn_size)
     p["attn"] = {
         "qkv": linear_ops.init_linear(
             keys[0], h, (nh + 2 * nkv) * d, shard="column", dtype=dtype,
@@ -216,6 +252,17 @@ def init_params(key: jax.Array, cfg: GPTConfig, policy: DtypePolicy | None = Non
                 )
             ).astype(dtype)
         }
+    if cfg.num_tokentypes > 0:
+        # segment embeddings (reference language_model.py:194-328)
+        params["tokentype_embed"] = {
+            "embedding": (
+                cfg.initializer_range
+                * jax.random.truncated_normal(
+                    jax.random.fold_in(kpos, 7), -2.0, 2.0,
+                    (cfg.num_tokentypes, cfg.hidden_size),
+                )
+            ).astype(dtype)
+        }
     layer_keys = jax.random.split(klayers, cfg.num_layers)
     if cfg.moe is not None and cfg.moe_frequency > 1:
         f, g = cfg.moe_frequency, num_moe_layers(cfg)
@@ -237,7 +284,10 @@ def init_params(key: jax.Array, cfg: GPTConfig, policy: DtypePolicy | None = Non
         params["layers"] = dense_stack
     else:
         params["layers"] = jax.vmap(lambda k: _init_layer(k, cfg, dtype))(layer_keys)
-    params["final_norm"] = _norm_init(cfg, dtype)
+    if cfg.transformer_block_type != "post_ln":
+        # post_ln layers end with their own LN — the reference builds no
+        # final_layernorm for that layout (transformer.py:2478, 2569-2570)
+        params["final_norm"] = _norm_init(cfg, dtype)
     if not cfg.share_embeddings_and_output_weights:
         params["lm_head"], _ = linear_ops.init_linear(
             khead, cfg.hidden_size, cfg.vocab_size, shard="column", dtype=dtype,
@@ -273,6 +323,9 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False):
         mlp = dense_mlp
     layer = {"input_norm": n, "post_attn_norm": n, "attn": attn,
              "mlp": mlp if mlp is not None else dense_mlp}
+    if cfg.transformer_block_type == "normformer":
+        layer["nf_attn_norm"] = n
+        layer["nf_mlp_norm"] = n
     lead = "pipe" if pipeline else None
     stacked = jax.tree_util.tree_map(
         lambda s: P(*((lead,) + tuple(s))), layer, is_leaf=lambda x: isinstance(x, P)
@@ -293,10 +346,13 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False):
     specs: dict[str, Any] = {
         "embed": {"embedding": P("model", None)},
         "layers": stacked,
-        "final_norm": _norm_specs(cfg),
     }
+    if cfg.transformer_block_type != "post_ln":
+        specs["final_norm"] = _norm_specs(cfg)
     if cfg.position_embedding_type == "learned_absolute":
         specs["pos_embed"] = {"embedding": P(None, None)}
+    if cfg.num_tokentypes > 0:
+        specs["tokentype_embed"] = {"embedding": P(None, None)}
     if not cfg.share_embeddings_and_output_weights:
         specs["lm_head"] = {"w": P(None, "model")}
     return specs
@@ -356,7 +412,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
     return out
 
 
-def _mlp_block(cfg, lp, x, policy):
+def _mlp_block(cfg, lp, x, policy, mid_norm=None):
     if cfg.moe is not None and "router" in lp:
         y, aux = moe_ops.moe_block(lp, x, cfg.moe, compute_dtype=policy.compute_dtype)
         aux_loss = moe_ops.weighted_router_loss(
@@ -365,31 +421,92 @@ def _mlp_block(cfg, lp, x, policy):
         return y, aux_loss
     y = linear_ops.apply_linear(lp["up"], x)
     y = _activation(cfg, y)
+    if mid_norm is not None:
+        # normformer mid-MLP norm (full ffn width; see module docstring for
+        # the per-partition deviation from the reference)
+        y = _apply_norm(cfg, mid_norm, y)
     return linear_ops.apply_linear(lp["down"], y), jnp.zeros((), jnp.float32)
 
 
 def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
                    attention_mask=None, return_kv=False):
+    """One transformer block in the configured layout
+    (reference ``transformer.py:1468-2084``):
+
+    - ``pre_ln``      x += drop(attn(LN1(x)));        x += drop(mlp(LN2(x)))
+    - ``post_ln``     x = LN1(x + drop(attn(x)));     x = LN2(x + drop(mlp(x)))
+    - ``normformer``  x += drop(LNa(attn(LN1(x))));   x += drop(mlp_mid(LN2(x)))
+    - ``gpt_j``       x += drop(attn(LN1(x))) + drop(mlp(LN2(x)))
+      (parallel residual; LN1/LN2 are two independent norms, reference
+      ``transformer.py:1908-1914``)
+    """
     aspec = shd.act_spec(cfg.sequence_parallel, False)
+    bt = cfg.transformer_block_type
     k1 = k2 = None
     if dropout_key is not None:
         k1, k2 = jax.random.split(dropout_key)
+
+    if bt == "gpt_j":
+        attn_in = _apply_norm(cfg, lp["input_norm"], x)
+        attn_out = _attention_block(cfg, lp["attn"], attn_in, cos, sin, policy,
+                                    attention_mask=attention_mask,
+                                    return_kv=return_kv)
+        kv = None
+        if return_kv:
+            attn_out, kv = attn_out
+        mlp_in = _apply_norm(cfg, lp["post_attn_norm"], x)
+        mlp_out, aux_loss = _mlp_block(cfg, lp["mlp"], mlp_in, policy)
+        x = shd.constrain(
+            x + _dropout(attn_out, cfg.hidden_dropout, k1)
+            + _dropout(mlp_out, cfg.hidden_dropout, k2), aspec)
+        if return_kv:
+            return x, aux_loss, kv
+        return x, aux_loss
+
     residual = x
-    hidden = _apply_norm(cfg, lp["input_norm"], x)
-    hidden = _attention_block(cfg, lp["attn"], hidden, cos, sin, policy,
+    attn_in = x if bt == "post_ln" else _apply_norm(cfg, lp["input_norm"], x)
+    hidden = _attention_block(cfg, lp["attn"], attn_in, cos, sin, policy,
                               attention_mask=attention_mask,
                               return_kv=return_kv)
     kv = None
     if return_kv:
         hidden, kv = hidden
-    x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k1), aspec)
+    if bt == "normformer":
+        hidden = _apply_norm(cfg, lp["nf_attn_norm"], hidden)
+    x = residual + _dropout(hidden, cfg.hidden_dropout, k1)
+    if bt == "post_ln":
+        x = _apply_norm(cfg, lp["input_norm"], x)
+    x = shd.constrain(x, aspec)
+
     residual = x
-    hidden = _apply_norm(cfg, lp["post_attn_norm"], x)
-    hidden, aux_loss = _mlp_block(cfg, lp["mlp"], hidden, policy)
-    x = shd.constrain(residual + _dropout(hidden, cfg.hidden_dropout, k2), aspec)
+    mlp_in = x if bt == "post_ln" else _apply_norm(cfg, lp["post_attn_norm"], x)
+    hidden, aux_loss = _mlp_block(
+        cfg, lp["mlp"], mlp_in, policy,
+        mid_norm=lp.get("nf_mlp_norm") if bt == "normformer" else None,
+    )
+    x = residual + _dropout(hidden, cfg.hidden_dropout, k2)
+    if bt == "post_ln":
+        x = _apply_norm(cfg, lp["post_attn_norm"], x)
+    x = shd.constrain(x, aspec)
     if return_kv:
         return x, aux_loss, kv
     return x, aux_loss
+
+
+def _add_tokentype(cfg: GPTConfig, params, x, tokentype_ids):
+    """Add segment embeddings (reference ``language_model.py:194-328``):
+    ids present without a table is a config error; a table without ids adds
+    nothing (the reference's optional-tokentype contract)."""
+    if tokentype_ids is None:
+        return x
+    if cfg.num_tokentypes <= 0:
+        raise ValueError(
+            "batch has tokentype_ids but model.num_tokentypes is 0; set "
+            "num_tokentypes to the number of segment types"
+        )
+    return x + jnp.take(
+        params["tokentype_embed"]["embedding"], tokentype_ids, axis=0
+    ).astype(x.dtype)
 
 
 def _rope_for(cfg: GPTConfig, input_ids: jax.Array, positions=None):
@@ -495,6 +612,7 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
             x = x + jnp.take(
                 params["pos_embed"]["embedding"], jnp.arange(s), axis=0
             ).astype(x.dtype)[None]
+        x = _add_tokentype(cfg, params, x, mb.get("tokentype_ids"))
         rng = mb.get("_rng")
         if rng is not None and cfg.embedding_dropout > 0.0:
             x = _dropout(x, cfg.embedding_dropout, jax.random.fold_in(rng, 0x0E))
@@ -548,7 +666,8 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
         return x, aux_sum
 
     def loss_fn(params, y, mb):
-        hidden = _apply_norm(cfg, params["final_norm"], y)
+        hidden = (y if cfg.transformer_block_type == "post_ln"
+                  else _apply_norm(cfg, params["final_norm"], y))
         logits = _logits_from_hidden(params, hidden, cfg, policy)
         labels = mb["labels"]
         loss_mask = mb.get("loss_mask")
@@ -592,6 +711,7 @@ def forward(
         x = x + jnp.take(
             params["pos_embed"]["embedding"], positions, axis=0
         ).astype(x.dtype)
+    x = _add_tokentype(cfg, params, x, batch.get("tokentype_ids"))
     cos, sin = _rope_for(cfg, input_ids, positions=positions)
     if rng is not None:
         rng, kemb = jax.random.split(rng)
@@ -628,7 +748,10 @@ def forward(
     if remat is not None:
         body = jax.checkpoint(body, policy=remat, prevent_cse=False)
     (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
-    hidden = _apply_norm(cfg, params["final_norm"], x)
+    # post_ln layers already end normalized; the reference has no final LN
+    # for that layout (transformer.py:2478, 2569-2570)
+    hidden = (x if cfg.transformer_block_type == "post_ln"
+              else _apply_norm(cfg, params["final_norm"], x))
     logits = _logits_from_hidden(params, hidden, cfg, policy)
 
     aux: dict[str, Any] = {}

@@ -114,21 +114,32 @@ def megatron_gpt_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
         if cfg.bias:
             mlp["up"]["bias"] = g(pre + "mlp.dense_h_to_4h.bias")
             mlp["down"]["bias"] = g(pre + "mlp.dense_4h_to_h.bias")
-        layers.append({
+        layer = {
             "input_norm": norm(pre + "input_layernorm"),
             "post_attn_norm": norm(pre + "post_attention_layernorm"),
             "attn": attn,
             "mlp": mlp,
-        })
+        }
+        if getattr(cfg, "transformer_block_type", "pre_ln") == "normformer":
+            # reference normformer extras (transformer.py:1638-1644, 181-198)
+            layer["nf_attn_norm"] = norm(pre + "post_attention_normformer_norm")
+            layer["nf_mlp_norm"] = norm(pre + "mlp.normalization")
+        layers.append(layer)
 
     params: dict[str, Any] = {
         "embed": {"embedding": g("embedding.word_embeddings.weight")},
         "layers": _stack(layers),
-        "final_norm": norm("encoder.final_layernorm"),
     }
+    if getattr(cfg, "transformer_block_type", "pre_ln") != "post_ln":
+        # post_ln has no final layernorm (reference transformer.py:2478)
+        params["final_norm"] = norm("encoder.final_layernorm")
     if cfg.position_embedding_type == "learned_absolute":
         params["pos_embed"] = {
             "embedding": g("embedding.position_embeddings.weight")
+        }
+    if getattr(cfg, "num_tokentypes", 0) > 0:
+        params["tokentype_embed"] = {
+            "embedding": g("embedding.tokentype_embeddings.weight")
         }
     if not cfg.share_embeddings_and_output_weights:
         params["lm_head"] = {"w": _t(g("output_layer.weight"))}
@@ -144,6 +155,9 @@ def native_to_megatron_gpt(params: Mapping[str, Any], cfg) -> dict[str, np.ndarr
     p("embedding.word_embeddings.weight", params["embed"]["embedding"])
     if cfg.position_embedding_type == "learned_absolute":
         p("embedding.position_embeddings.weight", params["pos_embed"]["embedding"])
+    if getattr(cfg, "num_tokentypes", 0) > 0:
+        p("embedding.tokentype_embeddings.weight",
+          params["tokentype_embed"]["embedding"])
 
     def put_norm(prefix, tree):
         p(prefix + ".weight", tree["scale"])
@@ -155,6 +169,9 @@ def native_to_megatron_gpt(params: Mapping[str, Any], cfg) -> dict[str, np.ndarr
         lp = _unstack(params["layers"], i)
         put_norm(pre + "input_layernorm", lp["input_norm"])
         put_norm(pre + "post_attention_layernorm", lp["post_attn_norm"])
+        if getattr(cfg, "transformer_block_type", "pre_ln") == "normformer":
+            put_norm(pre + "post_attention_normformer_norm", lp["nf_attn_norm"])
+            put_norm(pre + "mlp.normalization", lp["nf_mlp_norm"])
         qkv_t = _t(lp["attn"]["qkv"]["w"])  # [(nh+2kv)d, H]
         q, k, v = np.split(qkv_t, [nh * d, (nh + nkv) * d], axis=0)
         p(pre + "self_attention.query_key_value.weight",
@@ -171,7 +188,8 @@ def native_to_megatron_gpt(params: Mapping[str, Any], cfg) -> dict[str, np.ndarr
             p(pre + "self_attention.dense.bias", lp["attn"]["o"]["bias"])
             p(pre + "mlp.dense_h_to_4h.bias", lp["mlp"]["up"]["bias"])
             p(pre + "mlp.dense_4h_to_h.bias", lp["mlp"]["down"]["bias"])
-    put_norm("encoder.final_layernorm", params["final_norm"])
+    if getattr(cfg, "transformer_block_type", "pre_ln") != "post_ln":
+        put_norm("encoder.final_layernorm", params["final_norm"])
     if not cfg.share_embeddings_and_output_weights:
         p("output_layer.weight", _t(params["lm_head"]["w"]))
     return out
@@ -193,8 +211,15 @@ _TP_AXIS: list[tuple[str, int | None]] = [
     ("mlp.dense_4h_to_h.weight", 1),
     ("mlp.dense_4h_to_h.bias", None),
     ("output_layer.weight", 0),
+    # reference normformer mid-MLP norm is PER-PARTITION (width ffn/tp,
+    # transformer.py:181-198) — TP shards concatenate along the width
+    ("mlp.normalization.weight", 0),
+    ("mlp.normalization.bias", 0),
+    ("embedding.tokentype_embeddings.weight", None),
     ("layernorm.weight", None),
     ("layernorm.bias", None),
+    ("normformer_norm.weight", None),
+    ("normformer_norm.bias", None),
 ]
 
 

@@ -242,3 +242,12 @@ class TestValidationCatalog:
         self._expect("dropless",
                      **{"model.moe.dropless": True,
                         "model.moe.capacity_factor": 1.5})
+
+    def test_unknown_block_type(self):
+        self._expect("transformer_block_type",
+                     **{"model.transformer_block_type": "sandwich"})
+
+    def test_normformer_moe_conflict(self):
+        self._expect("dense-only",
+                     **{"model.transformer_block_type": "normformer",
+                        "model.moe.num_experts": 4})

@@ -46,7 +46,11 @@ class TestRoundTrip:
         make_cfg(num_query_groups=None, normalization="rmsnorm", bias=False,
                  position_embedding_type="rope",
                  share_embeddings_and_output_weights=False),
-    ], ids=["gqa-learned-ln-tied", "mha-rope-rms-untied"])
+        make_cfg(transformer_block_type="normformer", num_tokentypes=2),
+        make_cfg(transformer_block_type="post_ln"),
+        make_cfg(transformer_block_type="gpt_j"),
+    ], ids=["gqa-learned-ln-tied", "mha-rope-rms-untied",
+            "normformer-tokentype", "post_ln", "gpt_j"])
     def test_native_megatron_native(self, cfg):
         params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
         meg = native_to_megatron_gpt(params, cfg)

@@ -192,3 +192,30 @@ class TestInterleavedDecode:
         out = decode.generate_cached(params, cfg, FP32, ids, lens,
                                      max_new_tokens=8, eos_id=96, pad_id=0)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestBlockTypeDecode:
+    """Cached decode matches forward() for every transformer block layout
+    (the decode path previously hardcoded pre_ln)."""
+
+    @pytest.mark.parametrize("bt", ["post_ln", "normformer", "gpt_j"])
+    def test_gpt_block_type_greedy_parity(self, bt):
+        from neuronx_distributed_training_tpu.models import gpt
+
+        cfg = gpt.GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, transformer_block_type=bt,
+            activations_checkpoint_granularity=None,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        prompts = [[5, 6, 7, 8, 9], [10, 11, 12]]
+        ids, lens = pad_prompts(prompts, pad_id=0)
+
+        def logits_of(p, buf):
+            return gpt.forward(p, {"input_ids": buf}, cfg, FP32)[0]
+
+        ref = generate(params, ids, lens, logits_of, max_new_tokens=6,
+                       eos_id=96, pad_id=0)
+        out = decode.generate_cached(params, cfg, FP32, ids, lens,
+                                     max_new_tokens=6, eos_id=96, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
