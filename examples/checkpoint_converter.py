@@ -85,17 +85,20 @@ def main() -> None:
 
         cfg = gpt_mod.GPTConfig.from_config(model_block, ds_block)
         to_native = lambda sd: convert_megatron.megatron_gpt_to_native(sd, cfg)
-        to_hf = lambda p: convert_megatron.native_to_megatron_gpt(p, cfg)
+        to_hf = lambda p, layer_layout=None: convert_megatron.native_to_megatron_gpt(
+            p, cfg, layer_layout=layer_layout)
     elif args.model == "llama":
         cfg = llama_mod.LlamaConfig.from_config(model_block, ds_block)
         to_native = lambda sd: convert.hf_llama_to_native(sd, cfg)
-        to_hf = lambda p: convert.native_to_hf_llama(p, cfg)
+        to_hf = lambda p, layer_layout=None: convert.native_to_hf_llama(
+            p, cfg, layer_layout=layer_layout)
     else:
         from neuronx_distributed_training_tpu.models import mixtral as mixtral_mod
 
         cfg = mixtral_mod.MixtralConfig.from_config(model_block, ds_block)
         to_native = lambda sd: convert.hf_mixtral_to_native(sd, cfg)
-        to_hf = lambda p: convert.native_to_hf_mixtral(p, cfg)
+        to_hf = lambda p, layer_layout=None: convert.native_to_hf_mixtral(
+            p, cfg, layer_layout=layer_layout)
 
     out = Path(args.output)
     if args.direction in ("hf2native", "nnm2native"):
@@ -117,9 +120,16 @@ def main() -> None:
     else:
         with ocp.CheckpointManager(Path(args.input).absolute()) as mgr:
             step = args.step or mgr.latest_step()
+            layout = None
+            try:
+                meta = mgr.restore(step, args=ocp.args.Composite(
+                    meta=ocp.args.JsonRestore()))["meta"]
+                layout = (meta or {}).get("layer_layout")
+            except Exception:
+                pass  # metadata-less checkpoint: shape heuristic fallback
             restored = mgr.restore(step, args=ocp.args.Composite(
                 params=ocp.args.StandardRestore()))
-        sd = to_hf(restored["params"])
+        sd = to_hf(restored["params"], layer_layout=layout)
         out.mkdir(parents=True, exist_ok=True)
         try:
             from safetensors.numpy import save_file

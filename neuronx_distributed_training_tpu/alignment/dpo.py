@@ -71,6 +71,35 @@ def compute_reference_logprobs(
     }
 
 
+def iter_reference_logprobs(
+    params: Any,
+    batches: Iterable[dict[str, np.ndarray]],
+    forward_logits: ForwardLogits,
+):
+    """Streaming variant of ``compute_reference_logprobs``: yields the column
+    dict per batch so the caller can log progress and spill incrementally
+    (one jit compile shared across batches)."""
+
+    @jax.jit
+    def one(params, batch):
+        out = {}
+        for side in ("chosen", "rejected"):
+            logits, _reg = _call_forward(
+                forward_logits, params, {"input_ids": batch[f"{side}_input_ids"]}
+            )
+            out[side] = sequence_logprobs(
+                logits, batch[f"{side}_input_ids"], batch.get(f"{side}_loss_mask")
+            )
+        return out
+
+    for batch in batches:
+        res = one(params, batch)
+        yield {
+            "reference_chosen_logps": np.asarray(res["chosen"]),
+            "reference_rejected_logps": np.asarray(res["rejected"]),
+        }
+
+
 def preference_pipeline_hooks(embed_fn, stage_fn, head_fn, *, mode: str = "dpo",
                               beta: float = 0.1):
     """Wrap a model's pipeline hooks for DPO/ORPO under pipeline parallelism.

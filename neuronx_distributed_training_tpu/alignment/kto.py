@@ -50,6 +50,27 @@ def compute_reference_logprobs_kto(
     return {"reference_logps": np.concatenate(out)}
 
 
+def iter_reference_logprobs_kto(
+    params: Any,
+    batches: Iterable[dict[str, np.ndarray]],
+    forward_logits: ForwardLogits,
+):
+    """Streaming variant of ``compute_reference_logprobs_kto`` (per-batch
+    yield; one shared jit)."""
+
+    @jax.jit
+    def one(params, batch):
+        logits, _reg = _call_forward(
+            forward_logits, params, {"input_ids": batch["input_ids"]}
+        )
+        return sequence_logprobs(
+            logits, batch["input_ids"], batch.get("loss_mask")
+        )
+
+    for batch in batches:
+        yield {"reference_logps": np.asarray(one(params, batch))}
+
+
 def make_kto_loss_fn(
     forward_logits: ForwardLogits,
     *,

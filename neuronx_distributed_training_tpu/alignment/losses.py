@@ -110,10 +110,24 @@ def kto_loss(
     surface.
 
     Per-example reward ``r = beta * (logp_policy - logp_ref)``; the KL
-    baseline ``z0`` is the batch-mean reward clamped at 0 and detached (the
-    paper's shared-reference-point estimate).  Desirable examples maximize
-    ``sigmoid(r - z0)``, undesirable minimize via ``sigmoid(z0 - r)``, with
-    the lambda_D/lambda_U class weights for imbalanced feedback.
+    baseline ``z0`` is the batch-mean reward clamped at 0 and detached.
+    Desirable examples maximize ``sigmoid(r - z0)``, undesirable minimize via
+    ``sigmoid(z0 - r)``, with the lambda_D/lambda_U class weights for
+    imbalanced feedback.
+
+    .. warning:: **z0 deviates from arXiv:2402.01306 / TRL.**  The paper
+       estimates the KL term from MISMATCHED prompt/completion pairs
+       (shuffle completions within the batch so ``z0 ~ KL(policy||ref)`` on
+       off-policy text); here ``z0`` is the batch-mean reward of the ACTUAL
+       completions (per-microbatch under grad-accum/pipeline).  The loss
+       keeps the paper's shape, but as the policy improves on its own
+       completions the two baselines diverge: this ``z0`` (and the logged
+       ``kto_kl`` metric) grows with the mean reward itself, while the
+       paper's stays an off-policy KL estimate.  Expect ``kto_kl`` readings
+       and late-training gradients to differ from TRL numerically (not
+       directionally).  Shuffled-pair estimation needs cross-example logp
+       recompute per step — a deliberate cost/fidelity trade-off, revisit if
+       KTO parity with TRL matters.
     """
     r = beta * (policy_logps - reference_logps)
     z0 = jax.lax.stop_gradient(jnp.maximum(jnp.mean(r), 0.0))

@@ -269,13 +269,29 @@ def validate_config(cfg: ConfigDict) -> None:
         )
 
     # ---- model alignment --------------------------------------------------
-    align = model.get("model_alignment_strategy", {}) or {}
-    chosen = [k for k in ("dpo", "orpo", "kto", "sft") if k in align]
-    if len(chosen) > 1:
-        raise ValueError(
-            f"model_alignment_strategy must name exactly one of "
-            f"sft/dpo/orpo/kto, got {chosen}"
-        )
+    # root-level key (reference hf_llama3_8B_DPO_config.yaml:7); accepts a
+    # bare string ("dpo") or a one-key block ({dpo: {beta: ...}})
+    _ALIGN = ("sft", "dpo", "orpo", "kto")
+    align = cfg.get("model_alignment_strategy", None)
+    if isinstance(align, str):
+        if align.lower() not in _ALIGN:  # build.py lowercases the bare form
+            # a typo'd string would otherwise silently run plain pretraining
+            raise ValueError(
+                f"unknown model_alignment_strategy {align!r}; supported: "
+                f"{'/'.join(_ALIGN)}"
+            )
+    elif isinstance(align, Mapping) and align:
+        chosen = [k for k in _ALIGN if k in align]
+        if len(chosen) > 1:
+            raise ValueError(
+                f"model_alignment_strategy must name exactly one of "
+                f"{'/'.join(_ALIGN)}, got {chosen}"
+            )
+        if not chosen:
+            raise ValueError(
+                f"model_alignment_strategy block names none of "
+                f"{'/'.join(_ALIGN)}: got keys {sorted(align)}"
+            )
 
 
 def batch_schedule(cfg: ConfigDict, n_devices: int) -> dict[str, int]:

@@ -55,8 +55,15 @@ def _unstack(tree: dict[str, Any], i: int) -> dict[str, Any]:
 
 
 def deinterleave_layers(params: Mapping[str, Any], num_layers: int,
-                        moe_frequency: int = 1) -> dict[str, Any]:
+                        moe_frequency: int = 1,
+                        layout: str | None = None) -> dict[str, Any]:
     """Flatten a pipeline-interleaved ``layers`` stack back to ``[L, ...]``.
+
+    ``layout`` is the authoritative branch when known: checkpoints record
+    ``layer_layout`` ("flat" | "interleaved") in their meta JSON
+    (``trainer/loop.py`` ``save_checkpoint``) — pass it through and the shape
+    heuristic below is only the fallback for metadata-less pytrees
+    (ADVICE r2: shape sniffing alone can misfire on exotic leaf shapes).
 
     Checkpoints trained under virtual pipeline parallelism store layers in the
     ``to_interleaved`` layout ``[vp, pp, Lc, ...]`` (``trainer/loop.py`` keeps
@@ -69,11 +76,21 @@ def deinterleave_layers(params: Mapping[str, Any], num_layers: int,
     (stage-major order).  No-op for already-flat params.
     """
 
+    if layout == "flat":
+        return dict(params)
+    if layout not in (None, "interleaved"):
+        raise ValueError(f"unknown layer layout {layout!r} (flat|interleaved)")
+
     def flat(x, expect: int):
         x = np.asarray(x)
         if (x.ndim >= 3 and x.shape[0] != expect
                 and x.shape[0] * x.shape[1] * x.shape[2] == expect):
             return x.reshape((expect,) + x.shape[3:])
+        if layout == "interleaved" and x.shape[0] != expect:
+            raise ValueError(
+                f"checkpoint meta says layer_layout=interleaved but a leaf "
+                f"of shape {x.shape} cannot flatten to {expect} layers"
+            )
         return x
 
     def visit(tree, expect: int):
@@ -130,12 +147,14 @@ def hf_llama_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     return params
 
 
-def native_to_hf_llama(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
+def native_to_hf_llama(params: Mapping[str, Any], cfg,
+                       layer_layout: str | None = None) -> dict[str, np.ndarray]:
     """Native param pytree -> HF Llama state_dict (numpy).
 
     VPP-trained checkpoints (interleaved ``[vp, pp, Lc, ...]`` layer layout)
-    are flattened transparently."""
-    params = deinterleave_layers(params, cfg.num_layers)
+    are flattened transparently; pass the checkpoint's recorded
+    ``layer_layout`` meta when available."""
+    params = deinterleave_layers(params, cfg.num_layers, layout=layer_layout)
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     out: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
@@ -239,15 +258,18 @@ def hf_mixtral_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     return params
 
 
-def native_to_hf_mixtral(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
+def native_to_hf_mixtral(params: Mapping[str, Any], cfg,
+                         layer_layout: str | None = None) -> dict[str, np.ndarray]:
     """Native Mixtral pytree -> HF state_dict (inverse of
     ``hf_mixtral_to_native``; the reference's nxdt->HF direction,
     ``hf_nxdt_mixtral_ckpt_converter.py:62-91``).  Handles the grouped
     ``moe_frequency > 1`` layout (dense layers emit Llama ``mlp.*`` names)
-    and flattens VPP-interleaved checkpoints transparently."""
+    and flattens VPP-interleaved checkpoints transparently; pass the
+    checkpoint's recorded ``layer_layout`` meta when available."""
     lc, e = cfg.llama, cfg.moe.num_experts
     freq = getattr(cfg, "moe_frequency", 1)
-    params = deinterleave_layers(params, lc.num_layers, freq)
+    params = deinterleave_layers(params, lc.num_layers, freq,
+                                 layout=layer_layout)
     nh, nkv, d = lc.num_attention_heads, lc.kv_heads, lc.head_size
     f = lc.intermediate_size
     out: dict[str, np.ndarray] = {

@@ -146,8 +146,18 @@ def megatron_gpt_to_native(state: Mapping[str, Any], cfg) -> dict[str, Any]:
     return params
 
 
-def native_to_megatron_gpt(params: Mapping[str, Any], cfg) -> dict[str, np.ndarray]:
-    """Inverse of ``megatron_gpt_to_native`` (export / parity testing)."""
+def native_to_megatron_gpt(params: Mapping[str, Any], cfg,
+                           layer_layout: str | None = None) -> dict[str, np.ndarray]:
+    """Inverse of ``megatron_gpt_to_native`` (export / parity testing).
+
+    VPP-interleaved checkpoints flatten transparently; pass the checkpoint's
+    recorded ``layer_layout`` meta when available (same contract as
+    ``convert.native_to_hf_llama``)."""
+    from neuronx_distributed_training_tpu.tools.convert import deinterleave_layers
+
+    params = deinterleave_layers(params, cfg.num_layers,
+                                 getattr(cfg, "moe_frequency", 1),
+                                 layout=layer_layout)
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     out: dict[str, np.ndarray] = {}
     p = lambda name, v: out.update({"language_model." + name: np.asarray(v)})

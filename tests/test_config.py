@@ -235,8 +235,8 @@ class TestValidationCatalog:
 
     def test_two_alignment_strategies(self):
         self._expect("exactly one",
-                     **{"model.model_alignment_strategy.dpo.beta": 0.1,
-                        "model.model_alignment_strategy.kto.beta": 0.1})
+                     **{"model_alignment_strategy.dpo.beta": 0.1,
+                        "model_alignment_strategy.kto.beta": 0.1})
 
     def test_moe_dropless_capacity_conflict(self):
         self._expect("dropless",
@@ -251,3 +251,11 @@ class TestValidationCatalog:
         self._expect("dense-only",
                      **{"model.transformer_block_type": "normformer",
                         "model.moe.num_experts": 4})
+
+    def test_typod_alignment_string(self):
+        self._expect("unknown model_alignment_strategy",
+                     **{"model_alignment_strategy": "dp0"})
+
+    def test_alignment_block_without_known_name(self):
+        self._expect("names none",
+                     **{"model_alignment_strategy.ppo.beta": 0.1})

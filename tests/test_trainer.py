@@ -500,3 +500,85 @@ class TestNormLogging:
     def test_norms_off_by_default(self, tmp_path, devices8):
         metrics = train(tiny_cfg(tmp_path, max_steps=1))
         assert "param_norm" not in metrics
+
+
+class TestStreamedReferencePass:
+    """The DPO/KTO frozen-policy pass streams per-batch with an incremental
+    sidecar cursor, and attaches columns to the VAL module too (VERDICT r2
+    item 10 + ADVICE r2)."""
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    def _records(self, n):
+        return [{"prompt": f"q{i}", "chosen": "yes good", "rejected": "no"}
+                for i in range(n)]
+
+    def test_val_module_gets_reference_columns(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+        cfg = tiny_cfg(tmp_path, max_steps=1)
+        cfg["model_alignment_strategy"] = "dpo"
+        dm = DPODataModule(self._records(16), self.CharTok(), seq_length=32,
+                           global_batch_size=8)
+        vdm = DPODataModule(self._records(8), self.CharTok(), seq_length=32,
+                            global_batch_size=8)
+        t = Trainer.from_config(cfg, data_module=dm, val_data_module=vdm,
+                                enable_checkpointing=False)
+        t.pre_fit(t)
+        assert "reference_chosen_logps" in dm.arrays
+        assert "reference_chosen_logps" in vdm.arrays  # ADVICE r2 fix
+        # val eval runs without KeyError
+        assert np.isfinite(t.validate(1))
+
+    def test_sidecar_resumes_mid_pass(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+        n = 24
+        # full pass -> ground-truth columns + a complete sidecar
+        cfg = tiny_cfg(tmp_path, max_steps=1)
+        cfg["model_alignment_strategy"] = "dpo"
+        dm = DPODataModule(self._records(n), self.CharTok(), seq_length=32,
+                           global_batch_size=8)
+        t = Trainer.from_config(cfg, data_module=dm)
+        t.pre_fit(t)
+        full = {k: dm.arrays[k].copy()
+                for k in ("reference_chosen_logps", "reference_rejected_logps")}
+        sidecar = tmp_path / "exp" / "tiny" / "version_0" / "checkpoints" / \
+            "dpo_reference_logps.npz"
+        assert sidecar.exists()
+        saved = np.load(sidecar)
+        assert int(saved["_done_upto"]) == n
+
+        # truncate the sidecar to a mid-pass cursor (preemption at sample 8)
+        np.savez(sidecar, _done_upto=8,
+                 **{k: np.concatenate([full[k][:8], np.zeros(n - 8, full[k].dtype)])
+                    for k in full})
+        cfg2 = tiny_cfg(tmp_path, max_steps=1)
+        cfg2["model_alignment_strategy"] = "dpo"
+        dm2 = DPODataModule(self._records(n), self.CharTok(), seq_length=32,
+                            global_batch_size=8)
+        t2 = Trainer.from_config(cfg2, data_module=dm2)
+        t2.pre_fit(t2)
+        for k in full:
+            np.testing.assert_allclose(dm2.arrays[k], full[k], rtol=1e-5,
+                                       err_msg=f"{k} after mid-pass resume")
+
+    def test_kto_val_module_columns(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = [{"prompt": f"p{i}", "completion": "ok sure", "label": i % 2 == 0}
+                for i in range(16)]
+        cfg = tiny_cfg(tmp_path, max_steps=1)
+        cfg["model_alignment_strategy"] = {"kto": {"kl_beta": 0.2}}
+        dm = KTODataModule(recs, self.CharTok(), seq_length=32,
+                           global_batch_size=8)
+        vdm = KTODataModule(recs[:8], self.CharTok(), seq_length=32,
+                            global_batch_size=8)
+        t = Trainer.from_config(cfg, data_module=dm, val_data_module=vdm,
+                                enable_checkpointing=False)
+        t.pre_fit(t)
+        assert "reference_logps" in dm.arrays
+        assert "reference_logps" in vdm.arrays
