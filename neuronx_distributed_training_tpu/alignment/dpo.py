@@ -47,28 +47,8 @@ def compute_reference_logprobs(
     (the PaddedDPODataset key layout, reference ``PaddedDataset.py:60-103``).
     Returns the two reference-logp columns, concatenated in dataset order.
     """
-
-    @jax.jit
-    def one(params, batch):
-        out = {}
-        for side in ("chosen", "rejected"):
-            logits, _reg = _call_forward(
-                forward_logits, params, {"input_ids": batch[f"{side}_input_ids"]}
-            )
-            out[side] = sequence_logprobs(
-                logits, batch[f"{side}_input_ids"], batch.get(f"{side}_loss_mask")
-            )
-        return out
-
-    chosen, rejected = [], []
-    for batch in batches:
-        res = one(params, batch)
-        chosen.append(np.asarray(res["chosen"]))
-        rejected.append(np.asarray(res["rejected"]))
-    return {
-        "reference_chosen_logps": np.concatenate(chosen),
-        "reference_rejected_logps": np.concatenate(rejected),
-    }
+    parts = list(iter_reference_logprobs(params, batches, forward_logits))
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
 
 def iter_reference_logprobs(

@@ -34,20 +34,8 @@ def compute_reference_logprobs_kto(
     forward_logits: ForwardLogits,
 ) -> dict[str, np.ndarray]:
     """Frozen-policy completion log-probs over the train set -> one column."""
-
-    @jax.jit
-    def one(params, batch):
-        logits, _reg = _call_forward(
-            forward_logits, params, {"input_ids": batch["input_ids"]}
-        )
-        return sequence_logprobs(
-            logits, batch["input_ids"], batch.get("loss_mask")
-        )
-
-    out = []
-    for batch in batches:
-        out.append(np.asarray(one(params, batch)))
-    return {"reference_logps": np.concatenate(out)}
+    parts = list(iter_reference_logprobs_kto(params, batches, forward_logits))
+    return {"reference_logps": np.concatenate([p["reference_logps"] for p in parts])}
 
 
 def iter_reference_logprobs_kto(

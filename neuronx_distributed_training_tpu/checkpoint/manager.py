@@ -39,6 +39,15 @@ class CheckpointConfig:
     every_n_train_steps: int = 100
     async_save: bool = True
     monitor: str = "loss"  # metric whose *lowest* value defines "best"
+    # reference exp_manager.save_bf16 (exp_manager.py:58): store model weights
+    # in bf16 — halves params bytes; restore casts back up (resume is no
+    # longer bitwise, the knob's inherent trade)
+    save_bf16: bool = False
+    # reference checkpoint_callback_params.use_master_weights_in_ckpt
+    # (exp_manager.py:46, base.py:131): keep the fp32 master copy in the
+    # checkpoint.  Default True here (bitwise resume); False drops the master
+    # tree from the save and restore re-seeds it from the saved params.
+    use_master_weights_in_ckpt: bool = True
 
     @classmethod
     def from_config(cls, cfg: dict[str, Any]) -> "CheckpointConfig":
@@ -50,6 +59,9 @@ class CheckpointConfig:
             every_n_train_steps=int(cb.get("every_n_train_steps", 100)),
             async_save=bool(cb.get("async_checkpointing", em.get("async_checkpointing", True))),
             monitor=str(cb.get("monitor", "loss")),
+            save_bf16=bool(em.get("save_bf16", cb.get("save_bf16", False))),
+            use_master_weights_in_ckpt=bool(
+                cb.get("use_master_weights_in_ckpt", True)),
         )
 
 
@@ -63,6 +75,25 @@ class TrainState:
     step: int
     consumed_samples: int
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def resolve_checkpoint_dir(d: str | Path):
+    """Local paths -> absolute ``pathlib.Path``; remote URIs (``gs://`` etc.)
+    -> ``etils.epath.Path`` so Orbax streams through TensorStore instead of
+    silently writing a local directory literally named ``gs:`` (the failure
+    mode of ``Path(uri).absolute()``)."""
+    s = str(d)
+    if "://" not in s:
+        return Path(s).absolute()
+    from etils import epath
+
+    try:
+        return epath.Path(s)
+    except KeyError as e:
+        raise ValueError(
+            f"unsupported checkpoint URI scheme in {s!r}; epath supports "
+            f"gs:// and s3:// (local paths need no scheme)"
+        ) from e
 
 
 def _abstract_like(tree: Any, specs: Any, mesh: Optional[Mesh]) -> Any:
@@ -85,12 +116,33 @@ def _abstract_from_tree(tree: Any) -> Any:
     )
 
 
+def _bf16_read_templates(abs_tree: Any) -> Any:
+    """Downcast floating abstract leaves to bf16 — the on-disk dtype of a
+    ``save_bf16`` checkpoint (integer leaves, e.g. opt step, untouched)."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: (jax.ShapeDtypeStruct(a.shape, jnp.bfloat16, sharding=a.sharding)
+                   if jnp.issubdtype(a.dtype, jnp.floating) else a),
+        abs_tree,
+    )
+
+
+def _cast_like(tree: Any, abs_tree: Any) -> Any:
+    """Cast restored arrays up to the template dtype/sharding."""
+    return jax.tree_util.tree_map(
+        lambda x, a: (jax.device_put(x.astype(a.dtype), a.sharding)
+                      if a.sharding is not None else x.astype(a.dtype)),
+        tree, abs_tree,
+    )
+
+
 class Checkpointer:
     """Save/restore ``TrainState`` with retention + async + auto-resume."""
 
     def __init__(self, config: CheckpointConfig, *, keep_last: bool = True):
         self.config = config
-        directory = Path(config.dir).absolute()
+        directory = resolve_checkpoint_dir(config.dir)
         preservation = None
         if config.save_top_k > 0:
             from orbax.checkpoint.checkpoint_managers import preservation_policy as pp
@@ -128,16 +180,31 @@ class Checkpointer:
         metrics: Optional[dict[str, float]] = None,
         force: bool = False,
     ) -> bool:
+        params = state.params
+        if self.config.save_bf16:
+            import jax.numpy as jnp
+
+            params = jax.tree_util.tree_map(
+                lambda x: (x.astype(jnp.bfloat16)
+                           if jnp.issubdtype(x.dtype, jnp.floating) else x),
+                params,
+            )
+        opt_state = state.opt_state
+        if not self.config.use_master_weights_in_ckpt and "master" in opt_state:
+            opt_state = {k: v for k, v in opt_state.items() if k != "master"}
         meta = {
             "step": int(state.step),
             "consumed_samples": int(state.consumed_samples),
+            # restore branches on these (templates must match what was saved)
+            "save_bf16": bool(self.config.save_bf16),
+            "master_in_ckpt": "master" in opt_state,
             **{k: v for k, v in state.extra.items()},
         }
         return self._mgr.save(
             int(state.step),
             args=ocp.args.Composite(
-                params=ocp.args.StandardSave(state.params),
-                opt_state=ocp.args.StandardSave(state.opt_state),
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
                 meta=ocp.args.JsonSave(meta),
             ),
             metrics={k: float(v) for k, v in (metrics or {}).items()},
@@ -168,24 +235,44 @@ class Checkpointer:
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        # meta first: the save-time knobs (save_bf16, master dropped) change
+        # what templates must look like
+        meta = dict(self._mgr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )["meta"])
+        saved_bf16 = bool(meta.pop("save_bf16", False))
+        master_in = bool(meta.pop("master_in_ckpt", True))
         if mesh is not None and param_specs is not None:
             p_abs = _abstract_like(params_template, param_specs, mesh)
             o_abs = _abstract_like(opt_template, opt_specs, mesh)
         else:
             p_abs = _abstract_from_tree(params_template)
             o_abs = _abstract_from_tree(opt_template)
+        p_abs_read = _bf16_read_templates(p_abs) if saved_bf16 else p_abs
+        master_abs = None
+        if not master_in and isinstance(o_abs, dict) and "master" in o_abs:
+            master_abs = o_abs["master"]
+            o_abs = {k: v for k, v in o_abs.items() if k != "master"}
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(p_abs),
+                params=ocp.args.StandardRestore(p_abs_read),
                 opt_state=ocp.args.StandardRestore(o_abs),
-                meta=ocp.args.JsonRestore(),
             ),
         )
-        meta = dict(restored["meta"])
+        params = restored["params"]
+        if saved_bf16:
+            # cast back up to the template dtype (resume continues in the
+            # run's own precision regime; bf16 rounding is the knob's cost)
+            params = _cast_like(params, p_abs)
+        opt_state = dict(restored["opt_state"])
+        if master_abs is not None:
+            # master dropped at save time: re-seed fp32 master from the saved
+            # weights (the reference's use_master_weights_in_ckpt=False path)
+            opt_state["master"] = _cast_like(params, master_abs)
         return TrainState(
-            params=restored["params"],
-            opt_state=restored["opt_state"],
+            params=params,
+            opt_state=opt_state,
             step=int(meta.pop("step")),
             consumed_samples=int(meta.pop("consumed_samples")),
             extra=meta,
@@ -208,10 +295,22 @@ class Checkpointer:
             p_abs = _abstract_like(params_template, param_specs, mesh)
         else:
             p_abs = _abstract_from_tree(params_template)
+        saved_bf16 = False
+        try:
+            m = self._mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )["meta"]
+            saved_bf16 = bool((m or {}).get("save_bf16", False))
+        except Exception:
+            pass  # converter-written checkpoints carry no meta item
+        p_abs_read = _bf16_read_templates(p_abs) if saved_bf16 else p_abs
         restored = self._mgr.restore(
-            step, args=ocp.args.Composite(params=ocp.args.StandardRestore(p_abs))
+            step, args=ocp.args.Composite(params=ocp.args.StandardRestore(p_abs_read))
         )
-        return restored["params"]
+        params = restored["params"]
+        if saved_bf16:
+            params = _cast_like(params, p_abs)
+        return params
 
     def close(self) -> None:
         self._mgr.close()

@@ -272,6 +272,12 @@ def validate_config(cfg: ConfigDict) -> None:
     # root-level key (reference hf_llama3_8B_DPO_config.yaml:7); accepts a
     # bare string ("dpo") or a one-key block ({dpo: {beta: ...}})
     _ALIGN = ("sft", "dpo", "orpo", "kto")
+    if isinstance(model, Mapping) and "model_alignment_strategy" in model:
+        raise ValueError(
+            "model_alignment_strategy must sit at the config ROOT (the "
+            "reference schema, hf_llama3_8B_DPO_config.yaml:7), not under "
+            "model: — nested it would be silently ignored"
+        )
     align = cfg.get("model_alignment_strategy", None)
     if isinstance(align, str):
         if align.lower() not in _ALIGN:  # build.py lowercases the bare form

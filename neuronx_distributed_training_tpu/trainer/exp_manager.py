@@ -44,7 +44,14 @@ class ExpManager:
         log_local_rank_0_only: bool = False,
         log_global_rank_0_only: bool = False,
     ):
-        base = Path(exp_dir) / name
+        if "://" in str(exp_dir):
+            # remote store (gs:// etc.): epath keeps the scheme — Path()
+            # would mangle it into a local directory literally named "gs:"
+            from etils import epath
+
+            base = epath.Path(exp_dir) / name
+        else:
+            base = Path(exp_dir) / name
         if version is None:
             if resume_if_exists and base.exists():
                 versions = sorted(

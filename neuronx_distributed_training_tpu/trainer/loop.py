@@ -72,6 +72,60 @@ def parse_max_time(value: Any) -> Optional[float]:
     return float(((d * 24 + h) * 60 + m) * 60 + s)
 
 
+def _sidecar_load(path, tag):
+    """Read a reference-logp sidecar -> (done_upto, cols) or None.
+
+    URI paths (gs://) read through epath; local reads tolerate a truncated
+    file (crash mid-write predating the atomic spill) by recomputing."""
+    if path is None:
+        return None
+    try:
+        if "://" in str(path):
+            import io
+
+            from etils import epath
+
+            p = epath.Path(path)
+            if not p.exists():
+                return None
+            loaded = np.load(io.BytesIO(p.read_bytes()))
+        else:
+            import os
+
+            if not os.path.exists(path):
+                return None
+            loaded = np.load(path)
+    except Exception:
+        logger.warning("%s sidecar %s unreadable; recomputing", tag, path)
+        return None
+    files = [k for k in loaded.files if k != "_done_upto"]
+    done = int(loaded["_done_upto"]) if "_done_upto" in loaded.files else (
+        len(loaded[files[0]]) if files else 0)
+    return done, {k: np.array(loaded[k]) for k in files}
+
+
+def _sidecar_store(path, done, cols):
+    """Write the sidecar atomically: local tmp + rename, or a single remote
+    object write (object stores commit whole objects)."""
+    if "://" in str(path):
+        import io
+
+        from etils import epath
+
+        buf = io.BytesIO()
+        np.savez(buf, _done_upto=done, **cols)
+        p = epath.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(buf.getvalue())
+        return
+    import os
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, _done_upto=done, **cols)
+    os.replace(tmp, path)
+
+
 @dataclasses.dataclass
 class Trainer:
     """Assembled training session.  Build with ``Trainer.from_config``."""
@@ -519,27 +573,27 @@ class Trainer:
                 bs = min(dm.global_batch_size, n)
                 done = 0
                 cols: dict[str, np.ndarray] = {}
-                loaded = None
-                if sidecar is not None and os.path.exists(sidecar):
-                    try:
-                        loaded = np.load(sidecar)
-                    except Exception:
-                        # half-written sidecar (crash mid-write before the
-                        # atomic-rename spill existed): recompute from scratch
-                        logger.warning(
-                            "%s sidecar %s unreadable; recomputing", tag, sidecar)
+                loaded = _sidecar_load(sidecar, tag)
                 if loaded is not None:
-                    files = [k for k in loaded.files if k != "_done_upto"]
-                    done = int(loaded["_done_upto"]) if "_done_upto" in loaded.files else n
-                    cols = {k: np.array(loaded[k]) for k in files}
-                    if done >= n:
+                    done, cols = loaded
+                    if any(len(v) != n for v in cols.values()):
+                        # dataset grew/shrank since the sidecar was written:
+                        # stale columns would crash (or silently mis-attach)
+                        logger.warning(
+                            "%s sidecar %s has %d-sample columns but the "
+                            "dataset has %d; recomputing", tag, sidecar,
+                            len(next(iter(cols.values()))), n,
+                        )
+                        done, cols = 0, {}
+                    elif done >= n:
                         dm.attach_reference_logprobs(cols)
                         logger.info("%s reference logps restored from %s", tag, sidecar)
                         return
-                    logger.info(
-                        "%s reference pass resuming at %d/%d from %s",
-                        tag, done, n, sidecar,
-                    )
+                    else:
+                        logger.info(
+                            "%s reference pass resuming at %d/%d from %s",
+                            tag, done, n, sidecar,
+                        )
                 # batches restart AT the cursor (not at cursor rounded to a
                 # bs multiple): a resume with a different global_batch_size
                 # must still recompute every remaining sample
@@ -564,12 +618,7 @@ class Trainer:
                                     tag, done, n)
                     if sidecar is not None and ((j + 1) % spill_every == 0
                                                 or done >= n):
-                        # atomic: a preemption mid-write must not leave a
-                        # truncated .npz that breaks every later resume
-                        os.makedirs(os.path.dirname(sidecar), exist_ok=True)
-                        tmp = sidecar + ".tmp.npz"
-                        np.savez(tmp, _done_upto=done, **cols)
-                        os.replace(tmp, sidecar)
+                        _sidecar_store(sidecar, done, cols)
                 dm.attach_reference_logprobs(cols)
 
             def pre_fit(trainer: "Trainer") -> None:

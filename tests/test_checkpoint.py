@@ -125,3 +125,116 @@ class TestConfig:
         assert cfg.every_n_train_steps == 50
         assert cfg.monitor == "val_loss"  # passed through verbatim, never mangled
         assert str(cfg.dir) == "/tmp/exp"
+
+
+class TestPrecisionKnobs:
+    """save_bf16 + use_master_weights_in_ckpt (reference exp_manager.py:46,58,
+    nlp_overrides.py:618-630) — VERDICT r2 item 7."""
+
+    def _state(self):
+        params = {"w": jnp.linspace(0, 1, 32, dtype=jnp.float32).reshape(8, 4)}
+        opt = {
+            "mu": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "master": jax.tree_util.tree_map(lambda x: x + 0.5, params),
+            "step": jnp.asarray(3),
+        }
+        return TrainState(params=params, opt_state=opt, step=3,
+                          consumed_samples=24)
+
+    def test_save_bf16_halves_and_restores_cast_up(self, tmp_path):
+        cfg = CheckpointConfig(dir=tmp_path, async_save=False, save_bf16=True)
+        st = self._state()
+        with Checkpointer(cfg) as ck:
+            ck.save(st)
+            ck.wait()
+            restored = ck.restore(st.params, st.opt_state)
+        # restored at template dtype, values equal to a bf16 round-trip
+        assert restored.params["w"].dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]),
+            np.asarray(st.params["w"].astype(jnp.bfloat16).astype(jnp.float32)),
+        )
+        # integer leaves (opt step) untouched
+        assert int(restored.opt_state["step"]) == 3
+
+    def test_drop_master_reseeds_from_params(self, tmp_path):
+        cfg = CheckpointConfig(dir=tmp_path, async_save=False,
+                               use_master_weights_in_ckpt=False)
+        st = self._state()
+        with Checkpointer(cfg) as ck:
+            ck.save(st)
+            ck.wait()
+            # the master tree must not be on disk
+            restored = ck.restore(st.params, st.opt_state)
+        assert "master" in restored.opt_state
+        # re-seeded from the SAVED PARAMS, not the old master (+0.5)
+        np.testing.assert_array_equal(
+            np.asarray(restored.opt_state["master"]["w"]),
+            np.asarray(st.params["w"]),
+        )
+
+    def test_from_config_reads_knobs(self):
+        cfg = CheckpointConfig.from_config({
+            "exp_manager": {
+                "exp_dir": "/tmp/x",
+                "save_bf16": True,
+                "checkpoint_callback_params": {
+                    "use_master_weights_in_ckpt": False},
+            }
+        })
+        assert cfg.save_bf16 and not cfg.use_master_weights_in_ckpt
+
+    def test_bitwise_default_unchanged(self, tmp_path):
+        """Default knobs keep the bitwise round-trip (the resume-exactness
+        contract other tests pin)."""
+        cfg = CheckpointConfig(dir=tmp_path, async_save=False)
+        st = self._state()
+        with Checkpointer(cfg) as ck:
+            ck.save(st)
+            ck.wait()
+            restored = ck.restore(st.params, st.opt_state)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      np.asarray(st.params["w"]))
+        np.testing.assert_array_equal(
+            np.asarray(restored.opt_state["master"]["w"]),
+            np.asarray(st.opt_state["master"]["w"]))
+
+
+class TestRemoteStylePath:
+    """Remote-store path handling (reference saves to shared/remote stores;
+    zero-egress CI cannot reach a real bucket, so the contract is pinned at
+    the path-resolution seam)."""
+
+    def test_gs_uri_not_mangled(self):
+        from neuronx_distributed_training_tpu.checkpoint.manager import (
+            resolve_checkpoint_dir,
+        )
+
+        p = resolve_checkpoint_dir("gs://bucket/ckpts")
+        # keeps the scheme (an epath.Path) — Path().absolute() would turn it
+        # into a local directory literally named "gs:"
+        assert str(p).startswith("gs://bucket")
+
+    def test_unknown_scheme_raises(self):
+        from neuronx_distributed_training_tpu.checkpoint.manager import (
+            resolve_checkpoint_dir,
+        )
+
+        with pytest.raises(ValueError, match="URI scheme"):
+            resolve_checkpoint_dir("file:///tmp/x")
+
+    def test_epath_round_trip(self, tmp_path):
+        """Full save/restore through etils epath.Path — the same class the
+        gs:// path uses, exercising the TensorStore-facing path plumbing."""
+        from etils import epath
+
+        cfg = CheckpointConfig(dir=epath.Path(tmp_path) / "ckpt_epath",
+                               async_save=False)
+        with Checkpointer(cfg) as ck:
+            st = make_state(step=2, consumed=16, scale=3.0)
+            ck.save(st)
+            ck.wait()
+            restored = ck.restore(st.params, st.opt_state)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      np.asarray(st.params["w"]))
+        assert restored.step == 2

@@ -259,3 +259,7 @@ class TestValidationCatalog:
     def test_alignment_block_without_known_name(self):
         self._expect("names none",
                      **{"model_alignment_strategy.ppo.beta": 0.1})
+
+    def test_nested_alignment_rejected(self):
+        self._expect("config ROOT",
+                     **{"model.model_alignment_strategy": "dpo"})

@@ -582,3 +582,27 @@ class TestStreamedReferencePass:
         t.pre_fit(t)
         assert "reference_logps" in dm.arrays
         assert "reference_logps" in vdm.arrays
+
+    def test_stale_sidecar_size_mismatch_recomputes(self, tmp_path, devices8):
+        """A leftover sidecar from a differently-sized dataset must trigger a
+        clean recompute, not a broadcast crash or stale attach."""
+        from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+        cfg = tiny_cfg(tmp_path, max_steps=1)
+        cfg["model_alignment_strategy"] = "dpo"
+        dm = DPODataModule(self._records(16), self.CharTok(), seq_length=32,
+                           global_batch_size=8)
+        t = Trainer.from_config(cfg, data_module=dm)
+        t.pre_fit(t)
+        sidecar = tmp_path / "exp" / "tiny" / "version_0" / "checkpoints" / \
+            "dpo_reference_logps.npz"
+        assert sidecar.exists()
+
+        # dataset grows to 24 rows; old 16-row sidecar must be discarded
+        cfg2 = tiny_cfg(tmp_path, max_steps=1)
+        cfg2["model_alignment_strategy"] = "dpo"
+        dm2 = DPODataModule(self._records(24), self.CharTok(), seq_length=32,
+                            global_batch_size=8)
+        t2 = Trainer.from_config(cfg2, data_module=dm2)
+        t2.pre_fit(t2)
+        assert len(dm2.arrays["reference_chosen_logps"]) == 24
