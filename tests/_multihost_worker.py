@@ -1,0 +1,113 @@
+"""Worker for the 2-process multi-host smoke test (test_launch.py).
+
+Each process runs this with NXDT_COORDINATOR/NXDT_NUM_PROCESSES/
+NXDT_PROCESS_ID set (the explicit rendezvous triple detect_cluster
+prioritizes) and 4 virtual CPU devices, so the pair forms one 8-device
+global mesh — the same topology class as two TPU hosts on DCN (SURVEY §4
+plan item (b); reference rendezvous examples/train_setup.sh:8-67).
+
+Exercises, across REAL processes: jax.distributed rendezvous via
+utils.launch.initialize_distributed, a global mesh spanning both processes,
+per-process device_put slices assembled with
+jax.make_array_from_single_device_arrays (data/loader.shard_batch), and two
+jitted train steps whose gradient all-reduces ride the inter-process
+channel.  Prints LOSS/PARAMSUM lines the parent compares across ranks.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from neuronx_distributed_training_tpu.utils.launch import (
+        detect_cluster,
+        initialize_distributed,
+    )
+
+    spec = detect_cluster()
+    assert spec.managed_by == "nxdt-env", spec
+    initialize_distributed(spec)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from neuronx_distributed_training_tpu.data import SyntheticDataModule
+    from neuronx_distributed_training_tpu.models import llama
+    from neuronx_distributed_training_tpu.optim.adamw import (
+        AdamWConfig,
+        init_opt_state,
+        opt_state_specs,
+    )
+    from neuronx_distributed_training_tpu.parallel import sharding as shd
+    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from neuronx_distributed_training_tpu.trainer.step import (
+        jit_train_step,
+        make_train_step,
+    )
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+        activations_checkpoint_granularity=None,
+    )
+    policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                         softmax_dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))  # dp=4 x tp=2
+
+    with mesh, shd.use_mesh(mesh):
+        pspecs = llama.param_specs(cfg)
+        import functools
+
+        from jax.sharding import NamedSharding
+
+        ns = functools.partial(NamedSharding, mesh)
+        from jax.sharding import PartitionSpec as P
+
+        p_sh = jax.tree_util.tree_map(
+            ns, pspecs, is_leaf=lambda x: isinstance(x, P))
+        params = jax.jit(
+            lambda k: llama.init_params(k, cfg, policy), out_shardings=p_sh
+        )(jax.random.PRNGKey(0))
+        opt_cfg = AdamWConfig()
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy)
+        o_sh = jax.tree_util.tree_map(
+            ns, ospecs, is_leaf=lambda x: isinstance(x, P))
+        opt_state = jax.jit(
+            lambda p: init_opt_state(p, policy), out_shardings=o_sh
+        )(params)
+
+        def loss_fn(p, batch, key):
+            loss, aux = llama.forward(p, batch, cfg, policy)
+            return loss, aux
+
+        step_fn = make_train_step(
+            loss_fn, opt_cfg, lambda s: 1e-3, policy, num_microbatches=1)
+        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs)
+
+        dm = SyntheticDataModule(vocab_size=128, seq_len=32,
+                                 global_batch_size=8, seed=11)
+        it = dm.sharded_batches(mesh)
+        loss = None
+        for i, batch in enumerate(it):
+            if i >= 2:
+                break
+            params, opt_state, metrics = jstep(
+                params, opt_state, batch, jax.random.PRNGKey(i))
+            loss = float(metrics["loss"])
+        psum = float(sum(jnp.sum(x.astype(jnp.float64))
+                         for x in jax.tree_util.tree_leaves(params)))
+    print(f"LOSS {loss:.8f}")
+    print(f"PARAMSUM {psum:.6f}")
+    print("MULTIHOST_OK", jax.process_index())
+
+
+if __name__ == "__main__":
+    main()
