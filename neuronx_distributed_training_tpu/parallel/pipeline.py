@@ -198,6 +198,14 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
 
     # rematerialize stage activations in backward: only stage inputs are saved
     compute = jax.checkpoint(stage_fn)
+    # the embed and loss hooks run EVERY tick; un-rematerialized, their
+    # residuals are retained for all nm+pp-1 ticks — the loss hook's
+    # [mbs, s, vocab] logits dominate the high-water (measured 4.5x the
+    # unpipelined step at pp=4/nm=16, tools/pp_memory_probe.py).
+    # remat brings the schedule back to the stage-input O(nm * mbs*s*h)
+    # class, the same trade the reference's 1F1B-with-recompute makes.
+    embed = jax.checkpoint(embed_fn)
+    compute_loss = jax.checkpoint(loss_fn)
 
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -223,7 +231,7 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
             lambda x: jax.lax.dynamic_index_in_dim(x, m, 0, keepdims=False),
             microbatches,
         )
-        fresh = embed_fn(params, mb)
+        fresh = embed(params, mb)
         if vp > 1:
             parked = jax.lax.dynamic_index_in_dim(circ, m, 0, keepdims=False)
             first_in = jnp.where(c == 0, fresh, parked)
@@ -243,7 +251,7 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
         work_valid = jnp.logical_and(w >= 0, w < nm * vp)
         aux_acc = aux_acc + jnp.where(work_valid, s_aux, 0.0)
 
-        loss, denom = loss_fn(params, y, mb)
+        loss, denom = compute_loss(params, y, mb)
         valid = jnp.logical_and(
             jnp.logical_and(is_last, c == vp - 1), jnp.logical_and(w >= 0, w < nm * vp)
         )

@@ -1,0 +1,105 @@
+"""Pipeline activation-memory high-water probe (VERDICT r2 item 3).
+
+Question: does the GPipe-wavefront schedule (forward for all ``nm``
+microbatches, then autodiff reverse) retain O(nm) stage inputs vs 1F1B's
+O(pp) — at what cost, per XLA's own buffer assignment?
+
+Method: lower + compile the REAL jitted train step on the 8-device virtual
+CPU mesh at pp=4 / nm=16 (pp4 x dp2) and compare ``memory_analysis()``
+against (a) the unpipelined step with the identical per-device workload
+(dp=2, nm=16 microbatch scan) and (b) the analytic stage-input footprint.
+CPU-backend buffer assignment uses the same XLA pass as TPU, so the RATIO
+pipeline/unpipelined is meaningful even though absolute bytes differ from a
+TPU compile.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      PYTHONPATH=/root/repo:$PYTHONPATH python tools/pp_memory_probe.py
+"""
+
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from neuronx_distributed_training_tpu.config.loader import load_config  # noqa: E402
+from neuronx_distributed_training_tpu.trainer.loop import Trainer  # noqa: E402
+
+HIDDEN = 256
+LAYERS = 8
+SEQ = 512
+import os
+GBS = int(os.environ.get("PROBE_GBS", 32))  # dp=2, mbs=1 -> nm=GBS/2 at pp=4
+
+
+def cfg_for(pp: int) -> dict:
+    return {
+        "name": f"memprobe_pp{pp}",
+        "model_source": "hf",
+        "seed": 0,
+        "trainer": {"max_steps": 1, "log_every_n_steps": 1},
+        "distributed_strategy": {
+            "pipeline_model_parallel_size": pp,
+            "tensor_model_parallel_size": 1,
+        },
+        "data": {"global_batch_size": GBS, "micro_batch_size": 1,
+                 "seq_length": SEQ, "synthetic": True},
+        "model": {
+            "vocab_size": 2048,
+            "hidden_size": HIDDEN,
+            "intermediate_size": 2 * HIDDEN,
+            "num_layers": LAYERS,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 4,
+            "max_position_embeddings": SEQ,
+            "activations_checkpoint_granularity": "full",
+            "optim": {"name": "adamw_fp32OptState", "lr": 1e-4,
+                      "sched": {"name": "constant"}},
+        },
+        "precision": {"type": "fp32"},
+    }
+
+
+def measure(pp: int) -> dict:
+    t = Trainer.from_config(load_config(cfg_for(pp)), enable_checkpointing=False)
+    batch = next(t.data_module.sharded_batches(t.mesh))
+    lowered = t.train_step.lower(t.params, t.opt_state, batch, jax.random.PRNGKey(0))
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    out = {
+        "pp": pp,
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    del t
+    return out
+
+
+def main() -> None:
+    res = {}
+    for pp in (1, 4):
+        res[f"pp{pp}"] = measure(pp)
+        print(json.dumps(res[f"pp{pp}"]))
+    nm = GBS // (8 // res["pp4"]["pp"] // 1 * 1)  # dp = 8/pp
+    # analytic per-device stage-input footprint: [nm, mbs, seq, hidden] fp32
+    stage_inputs = 16 * 1 * SEQ * HIDDEN * 4
+    summary = {
+        "nm_pp4": 16,
+        "gpipe_stage_input_bytes_analytic": stage_inputs,
+        "onef1b_stage_input_bytes_analytic": res["pp4"]["pp"] * 1 * SEQ * HIDDEN * 4,
+        "temp_ratio_pp4_vs_pp1": round(
+            res["pp4"]["temp_bytes"] / max(res["pp1"]["temp_bytes"], 1), 3),
+        "pp4_temp_mb": round(res["pp4"]["temp_bytes"] / 2**20, 2),
+        "pp1_temp_mb": round(res["pp1"]["temp_bytes"] / 2**20, 2),
+    }
+    print(json.dumps(summary))
+    with open("bench_results/pp_memory_probe.json", "w") as f:
+        json.dump({**res, "summary": summary}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
