@@ -462,12 +462,17 @@ class Trainer:
             trainable_mask=trainable,
             ema_cfg=ema_cfg,
         )
-        # donation is disabled under EMA: donating an opt state that carries
-        # the EMA tree trips an INVALID_ARGUMENT in the (tunnelled) TPU
-        # runtime (plain jit and donate=False both run clean); EMA already
-        # costs +4 bytes/param, the lost aliasing is the smaller evil
+        # NARROWED EMA workaround (round 3): donating an opt state that
+        # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)
+        # TPU runtime (plain jit and donate=False both run clean; a CPU
+        # repro attempt found no buffer aliasing between params and the EMA
+        # tree, so the root cause sits in the TPU runtime's donation path).
+        # Donating PARAMS only keeps the big aliasing win and avoids the
+        # failing opt-state donation — the transient cost drops from
+        # params+opt to opt-state-only.  Revisit donate="all" under EMA when
+        # the backend can be exercised (tools/ema_donation_probe.py).
         jstep = jit_train_step(step_fn, mesh, pspecs, ospecs,
-                               donate=ema_cfg is None)
+                               donate=True if ema_cfg is None else "params")
         eval_fn = jax.jit(make_eval_step(eval_loss_fn)) if val_data_module else None
 
         # materialize sharded-at-birth: jit with out_shardings creates every

@@ -146,19 +146,26 @@ def jit_train_step(
     opt_specs,
     *,
     batch_spec: Optional[P] = None,
-    donate: bool = True,
+    donate: bool | str = True,
 ):
     """jit with explicit in/out shardings; params/opt-state donated (in-place
     buffer reuse — the memory behavior the reference gets from in-place
-    ``optimizer.step``)."""
+    ``optimizer.step``).
+
+    ``donate``: True/"all" donates params + opt state; "params" donates the
+    params tree only (the narrowed EMA workaround — see Trainer.from_config);
+    False/"none" disables donation."""
     if batch_spec is None:
         batch_spec = P(DATA_AXES)
     ns = functools.partial(NamedSharding, mesh)
     p_sh = jax.tree_util.tree_map(ns, param_specs, is_leaf=lambda x: isinstance(x, P))
     o_sh = jax.tree_util.tree_map(ns, opt_specs, is_leaf=lambda x: isinstance(x, P))
+    donate_argnums = {
+        True: (0, 1), "all": (0, 1), "params": (0,), False: (), "none": (),
+    }[donate]
     return jax.jit(
         train_step,
         in_shardings=(p_sh, o_sh, ns(batch_spec), None),
         out_shardings=(p_sh, o_sh, None),
-        donate_argnums=(0, 1) if donate else (),
+        donate_argnums=donate_argnums,
     )

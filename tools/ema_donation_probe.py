@@ -1,0 +1,51 @@
+"""Minimal on-device repro for the EMA opt-state donation INVALID_ARGUMENT.
+
+Round-2 observation (tunnelled TPU runtime): jitting the train step with
+``donate_argnums=(0, 1)`` fails with INVALID_ARGUMENT when the opt state
+carries the ``ema`` tree; donate-nothing and plain jit run clean.  A CPU
+repro attempt (round 3) found no params<->ema buffer aliasing, so the root
+cause sits in the TPU runtime's donation path, not in our pytrees.
+
+Run ON DEVICE (needs the axon TPU):
+    PYTHONPATH=/root/repo:$PYTHONPATH python tools/ema_donation_probe.py
+
+Prints one line per donation mode: ok / INVALID_ARGUMENT.  If "all" passes,
+remove the narrowed ``donate="params"`` workaround in trainer/loop.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    print("backend:", jax.default_backend(), jax.devices())
+    params = {"w": jnp.ones((512, 512), jnp.float32)}
+    opt = {
+        "mu": jax.tree_util.tree_map(jnp.zeros_like, params),
+        "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+        "ema": jax.tree_util.tree_map(lambda x: x * 1.0, params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+    def step(p, s):
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        mu = jax.tree_util.tree_map(lambda m, gg: 0.9 * m + gg, s["mu"], g)
+        nu = jax.tree_util.tree_map(lambda n, gg: 0.99 * n + gg * gg, s["nu"], g)
+        newp = jax.tree_util.tree_map(lambda x, m: x - 1e-3 * m, p, mu)
+        ema = jax.tree_util.tree_map(
+            lambda e, x: 0.99 * e + 0.01 * x, s["ema"], newp)
+        return newp, {"mu": mu, "nu": nu, "ema": ema, "step": s["step"] + 1}
+
+    for mode, argnums in (("none", ()), ("params", (0,)), ("all", (0, 1))):
+        try:
+            f = jax.jit(step, donate_argnums=argnums)
+            p2, s2 = f(jax.tree_util.tree_map(jnp.copy, params),
+                       jax.tree_util.tree_map(jnp.copy, opt))
+            # value fetch forces completion on the tunnelled backend
+            print(f"donate={mode}: ok (psum={float(jnp.sum(p2['w'])):.3f})")
+        except Exception as e:
+            print(f"donate={mode}: {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()
