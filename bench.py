@@ -115,17 +115,29 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
     return None, last
 
 
+def layer_budget(hbm_bytes: int, bytes_per_param: float, *,
+                 tied: bool = True, util: float = 0.80) -> int:
+    """Estimated deepest Llama-3-8B layer stack fitting ``hbm_bytes``."""
+    h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
+    per_layer = h * (nh + 2 * nkv) * (h // nh) + nh * (h // nh) * h + 3 * h * ffn
+    vocab_params = (1 if tied else 2) * vocab * h
+    budget_params = hbm_bytes * util / bytes_per_param
+    return max(1, min(32, int((budget_params - vocab_params) // per_layer)))
+
+
 def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | None,
-                hbm_bytes: int, bytes_per_param: float,
+                hbm_bytes: int, bytes_per_param: float, *, tied: bool = True,
                 block_q: int | None = None, block_kv: int | None = None):
-    """Llama-3-8B per-layer shapes, layer count auto-sized to HBM."""
+    """Llama-3-8B per-layer shapes, layer count auto-sized to HBM.
+
+    ``tied=True`` is the PINNED bench default (round-3 contract: one config,
+    tied embeddings, multi-layer — VERDICT r2): the fp32 master+opt state of
+    an untied 1.05B-param vocab pair alone eats ~2/3 of a 16G chip under
+    mixed precision."""
     if on_tpu:
         h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
         if layers is None:
-            per_layer = h * (nh + 2 * nkv) * (h // nh) + nh * (h // nh) * h + 3 * h * ffn
-            vocab_params = 2 * vocab * h
-            budget_params = hbm_bytes * 0.60 / bytes_per_param
-            layers = max(1, min(32, int((budget_params - vocab_params) // per_layer)))
+            layers = layer_budget(hbm_bytes, bytes_per_param, tied=tied)
         # long sequences: the [s, vocab] logits tensor (s*vocab*4B fp32)
         # dominates HBM — switch to the fused chunked head+CE, which never
         # materializes it (fusions.chunked_ce).  Fixed 8 GiB threshold, NOT a
@@ -144,6 +156,7 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
             num_kv_heads=nkv,
             max_position_embeddings=seq,
             rope_theta=500000.0,
+            tie_word_embeddings=tied,
             fuse_qkv=True,
             attention_impl=attn_impl,
             flash_block_q=block_q,
@@ -271,6 +284,9 @@ def main() -> None:
                          "model (perf experiment knob)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a platform (cpu for local smoke runs)")
+    ap.add_argument("--untied", action="store_true",
+                    help="untie embeddings/head (off the pinned bench config; "
+                         "for comparison runs only)")
     args = ap.parse_args()
 
     dev, backend_err = acquire_device(platform=args.platform)
@@ -314,43 +330,43 @@ def main() -> None:
     else:
         wanted = ["mixed_precision", "bf16"] if on_tpu else ["mixed_precision"]
 
+    import dataclasses
+
+    tied = not args.untied
     results: dict[str, dict] = {}
     errors: dict[str, str] = {}
     for name in wanted:
         policy, bpp = regimes[name]
-        cfg = make_config(llama, on_tpu, attn_impl, seq, args.layers, hbm, bpp,
-                          args.block_q, args.block_kv)
+        est = args.layers or layer_budget(hbm, bpp, tied=tied)
+        cfg = make_config(llama, on_tpu, attn_impl, seq, est, hbm, bpp,
+                          tied=tied, block_q=args.block_q, block_kv=args.block_kv)
         if args.remat != "selective":
-            import dataclasses
-
             cfg = dataclasses.replace(
                 cfg, activations_checkpoint_granularity=(
                     None if args.remat == "none" else args.remat))
-        log(f"bench[{name}]: device={dev.device_kind} layers={cfg.num_layers} "
-            f"seq={seq} mbs={args.mbs} attn={cfg.attention_impl}")
-        # OOM backoff: fewer layers, then tied embed+head (halves the 1.05B
-        # vocab params — the mixed-precision regime's fp32 master+opt state
-        # for untied 128256-vocab embeddings alone overflows a 16G chip)
-        tries: list[tuple[int, bool]] = []
-        for tied in (False, True):
-            for n in (cfg.num_layers, max(1, cfg.num_layers // 2)):
-                if (n, tied) not in tries:
-                    tries.append((n, tied))
-        for n_layers, tied in tries:
+        # deepest-stack search: probe one layer past the estimate (analytic
+        # budgets are conservative), then walk down on OOM.  Config stays
+        # PINNED otherwise — tied embeddings, same shapes, both regimes.
+        if args.layers:
+            candidates = [args.layers]
+        elif on_tpu:
+            candidates = sorted(
+                {est + 1, est, max(1, est - 1), 1}, reverse=True)
+        else:
+            candidates = [cfg.num_layers]
+        log(f"bench[{name}]: device={dev.device_kind} layer candidates="
+            f"{candidates} seq={seq} mbs={args.mbs} attn={cfg.attention_impl} "
+            f"tied={tied}")
+        for n_layers in candidates:
             try:
-                if n_layers != cfg.num_layers or tied:
-                    import dataclasses as _dc
-
-                    cfg = _dc.replace(
-                        cfg, num_layers=n_layers, tie_word_embeddings=tied)
-                    log(f"bench[{name}]: retrying layers={n_layers} tied={tied}")
+                cfg = dataclasses.replace(cfg, num_layers=n_layers)
                 results[name] = run_bench(
                     dev, cfg, policy, seq, args.mbs, steps, warmup)
                 results[name]["tied_embeddings"] = tied
                 errors.pop(name, None)  # a successful backoff clears the record
                 break
             except Exception as e:  # noqa: BLE001 — keep the other regime alive
-                errors[name] = f"{type(e).__name__}: {e}"
+                errors[name] = f"layers={n_layers}: {type(e).__name__}: {e}"
                 log(f"bench[{name}] failed: {errors[name]}\n{traceback.format_exc()}")
                 oom = any(s in errors[name] for s in
                           ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
@@ -363,8 +379,17 @@ def main() -> None:
                   device=getattr(dev, "device_kind", str(dev)))
         return
 
-    # headline: the baseline regime (mixed_precision) when available
-    headline = "mixed_precision" if "mixed_precision" in results else next(iter(results))
+    # headline: prefer the baseline regime (mixed_precision), but a
+    # single-layer stack never headlines over a multi-layer one — the
+    # round-3 contract is a multi-layer, pinned-config number.  On a 16G
+    # chip the mixed regime's fp32 master+opt state for the tied 0.53B-param
+    # embedding alone (~9.5 GB) can cap it at 1 layer; the bf16 regime then
+    # carries the multi-layer headline and mixed is reported alongside.
+    def _pref(name: str) -> tuple:
+        r = results[name]
+        return (r["num_layers"] > 1, name == "mixed_precision", r["mfu"])
+
+    headline = max(results, key=_pref)
     r = results[headline]
     payload = {
         "metric": "llama3_8B_pretrain_mfu",
@@ -377,12 +402,14 @@ def main() -> None:
         "device": dev.device_kind,
         "attn_impl": attn_impl,
         "num_layers": r["num_layers"],
-        "tied_embeddings": r.get("tied_embeddings", False),
+        "tied_embeddings": r.get("tied_embeddings", tied),
         "seq_len": seq,
-        "note": "layer count scaled to single-chip HBM; MFU is per-layer-shape-bound",
+        "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
+                 "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }
     for name, res in results.items():
         payload[f"mfu_{name}"] = round(100 * res["mfu"], 2)
+        payload[f"layers_{name}"] = res["num_layers"]
     if errors:
         payload["regime_errors"] = errors
     if backend_err:

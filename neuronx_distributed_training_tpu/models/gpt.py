@@ -555,6 +555,8 @@ def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
         else:
             mxs, dxs = inp
             k0 = None
+        # per-group cast inside the scan (one group's bf16 copy live at a time)
+        mxs = policy.cast_to_compute(mxs)
         x, aux = _decoder_layer(cfg, mxs, x, cos, sin, policy, k0,
                                 attention_mask=attention_mask)
 
@@ -564,6 +566,7 @@ def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
                 dlp, dk = dinp
             else:
                 dlp, dk = dinp, None
+            dlp = policy.cast_to_compute(dlp)
             x2, a2 = _decoder_layer(cfg, dlp, x2, cos, sin, policy, dk,
                                     attention_mask=attention_mask)
             return (x2, acc2 + a2), None
@@ -620,7 +623,6 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
 
     def stage_fn(local_layers, x, mb):
         cos, sin = _rope_for(cfg, mb["input_ids"])
-        local_layers = policy.cast_to_compute(local_layers)
         grouped = cfg.moe is not None and cfg.moe_frequency > 1
         if grouped:
             # local layer count = local groups x f (flat attn/norm slices)
@@ -650,6 +652,7 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
             def body(carry, inp):
                 x, aux_acc = carry
                 lp, lkey = inp
+                lp = policy.cast_to_compute(lp)
                 x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey)
                 return (x, aux_acc + aux), None
 
@@ -658,6 +661,7 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
 
             def body(carry, lp):
                 x, aux_acc = carry
+                lp = policy.cast_to_compute(lp)
                 x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, None)
                 return (x, aux_acc + aux), None
 
@@ -718,7 +722,7 @@ def forward(
         x = _dropout(x, cfg.embedding_dropout, kemb)
     x = shd.constrain(x, aspec)
 
-    layer_stack = policy.cast_to_compute(params["layers"])
+    layer_stack = params["layers"]
     layer_keys = (
         jax.random.split(rng, cfg.num_layers) if rng is not None else None
     )
@@ -736,6 +740,7 @@ def forward(
                 lp, lkey = inp
             else:
                 lp, lkey = inp, None
+            lp = policy.cast_to_compute(lp)  # per-layer cast (see llama)
             x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey,
                                     attention_mask=attention_mask)
             return (x, aux_acc + aux), None

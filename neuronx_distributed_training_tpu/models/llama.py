@@ -347,9 +347,12 @@ def hidden_states(
     cos, sin = rope_ops.rope_cos_sin(positions, inv_freq, dtype=jnp.float32)
 
     layer_stack = params["layers"] if layers is None else layers
-    layer_stack = policy.cast_to_compute(layer_stack)
 
     def body(carry, lp):
+        # cast INSIDE the scan body (and remat boundary): only one layer's
+        # bf16 copy is ever live, instead of a whole-stack bf16 duplicate —
+        # ~2 bytes/param of HBM back under mixed precision
+        lp = policy.cast_to_compute(lp)
         return _decoder_layer(lp, carry, cos, sin, cfg, policy,
                               attention_mask=attention_mask), None
 
@@ -419,9 +422,11 @@ def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy, *, shift_labels: bool 
 
     def stage_fn(local_layers, x, mb):
         cos, sin = _rope_for(mb["input_ids"], cfg)
-        local_layers = policy.cast_to_compute(local_layers)
 
         def body(carry, lp):
+            # per-layer cast inside the scan: one layer's bf16 copy live at
+            # a time (see forward())
+            lp = policy.cast_to_compute(lp)
             return _decoder_layer(lp, carry, cos, sin, cfg, policy), None
 
         x, _ = jax.lax.scan(body, x, local_layers)

@@ -163,12 +163,14 @@ def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
 
     def body(carry, gp):
         x, aux_acc = carry
-        x, aux = _decoder_layer(gp["moe"], x, cos, sin, cfg, policy,
-                                attention_mask=attention_mask)
+        # per-group cast inside the scan (one group's bf16 copy live at a time)
+        x, aux = _decoder_layer(policy.cast_to_compute(gp["moe"]), x, cos, sin,
+                                cfg, policy, attention_mask=attention_mask)
 
         def dense_body(x2, dlp):
             return llama._decoder_layer(
-                dlp, x2, cos, sin, lc, policy, attention_mask=attention_mask,
+                policy.cast_to_compute(dlp), x2, cos, sin, lc, policy,
+                attention_mask=attention_mask,
             ), None
 
         x, _ = jax.lax.scan(dense_body, x, gp["dense"])
@@ -226,12 +228,13 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
 
     def stage_fn(local_layers, x, mb):
         cos, sin = llama._rope_for(mb["input_ids"], lc)
-        ll = policy.cast_to_compute(local_layers)
+        ll = local_layers
 
         if cfg.moe_frequency == 1:
 
             def body(carry, lp):
                 x, aux_acc = carry
+                lp = policy.cast_to_compute(lp)  # per-layer cast (see llama)
                 x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy)
                 return (x, aux_acc + aux), None
 
@@ -287,13 +290,14 @@ def forward(
     cos, sin = llama._rope_for(
         input_ids, lc, positions=llama.positions_for(input_ids, attention_mask)
     )
-    layer_stack = policy.cast_to_compute(params["layers"])
+    layer_stack = params["layers"]
     remat = llama._remat_policy(lc.activations_checkpoint_granularity)
 
     if cfg.moe_frequency == 1:
 
         def body(carry, lp):
             x, aux_acc = carry
+            lp = policy.cast_to_compute(lp)  # per-layer cast (see llama)
             x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy,
                                     attention_mask=attention_mask)
             return (x, aux_acc + aux), None
