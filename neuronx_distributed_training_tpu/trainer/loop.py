@@ -514,11 +514,13 @@ class Trainer:
                 out_shardings=shardings(ospecs),
             )(params)
 
-        # opt-in sharding sanity gate (SURVEY.md §5.2 "jit-time shape/sharding
+        # sharding sanity gate (SURVEY.md §5.2 "jit-time shape/sharding
         # assertions" — the TPU-native analogue of the reference's
         # HLO-consistency discipline): fail fast on silent replication or a
-        # dropped constraint instead of discovering it as a perf mystery
-        if bool((cfg.get("debug", {}) or {}).get("validate_sharding")):
+        # dropped constraint instead of discovering it as a perf mystery.
+        # DEFAULT ON since round 3 — it is a pure metadata comparison (no
+        # device work); set debug.validate_sharding: false to opt out.
+        if bool((cfg.get("debug", {}) or {}).get("validate_sharding", True)):
             from neuronx_distributed_training_tpu.utils.debug import (
                 assert_tree_sharding,
             )
