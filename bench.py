@@ -35,8 +35,9 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
-# Most recent successful on-hardware measurements (committed alongside in
-# bench_results/): carried in the diagnostic JSON so a transient tunnel/backend
+# Most recent successful on-hardware measurement (round-2 fallback; freshly
+# measured runs overwrite bench_results/last_measured.json, which takes
+# precedence): carried in the diagnostic JSON so a transient tunnel/backend
 # outage at bench time doesn't erase the evidence of what the code measured.
 LAST_MEASURED = {
     "date": "2026-07-30",
@@ -48,6 +49,37 @@ LAST_MEASURED = {
     "note": "flash tile kv=2048 defaults; see bench_results/ for full lines",
 }
 
+_LAST_MEASURED_PATH = "bench_results/last_measured.json"
+_MEASURED_LOG = "bench_results/r3_v5e_measured.jsonl"
+
+
+def load_last_measured() -> dict:
+    import os
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(base, _LAST_MEASURED_PATH)) as f:
+            return json.load(f)
+    except Exception:
+        return LAST_MEASURED
+
+
+def record_measurement(payload: dict) -> None:
+    """Append the successful on-hardware line to the evidence log and refresh
+    last_measured.json — the builder-recorded trail survives later outages."""
+    import os
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    try:
+        os.makedirs(os.path.join(base, "bench_results"), exist_ok=True)
+        line = {"date": time.strftime("%Y-%m-%d"), **payload}
+        with open(os.path.join(base, _MEASURED_LOG), "a") as f:
+            f.write(json.dumps(line) + "\n")
+        with open(os.path.join(base, _LAST_MEASURED_PATH), "w") as f:
+            json.dump(line, f, indent=1)
+    except Exception as e:  # noqa: BLE001 — recording must never fail the bench
+        log(f"bench: could not record measurement: {e}")
+
 
 def fail_json(err: str, **extra) -> None:
     emit({
@@ -56,7 +88,7 @@ def fail_json(err: str, **extra) -> None:
         "unit": "percent_mfu",
         "vs_baseline": 0.0,
         "error": err[-2000:],
-        "last_measured": LAST_MEASURED,
+        "last_measured": load_last_measured(),
         **extra,
     })
 
@@ -414,6 +446,8 @@ def main() -> None:
         payload["regime_errors"] = errors
     if backend_err:
         payload["backend_retries"] = backend_err
+    if on_tpu:
+        record_measurement(payload)
     emit(payload)
 
 
