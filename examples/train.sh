@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Cluster launch wrapper — the counterpart of the reference's train.sh +
+# train_setup.sh pair (reference examples/train.sh, train_setup.sh:8-67),
+# redesigned for the TPU stack:
+#
+#   - NO torchrun / process manager: one python process per HOST (TPU hosts
+#     drive all local chips through one process); the in-process rendezvous
+#     (utils/launch.detect_cluster -> jax.distributed.initialize) reads the
+#     SLURM / Open MPI / NXDT_* environment directly, so this script only
+#     selects the config, shapes log paths, and execs python.
+#   - COMPILE=1 -> --compile-only (AOT warm-up against the persistent XLA
+#     compile cache; the neuron_parallel_compile equivalent).
+#   - TRAIN_ITERS=N short-run override passes through to the CLI.
+#
+# Usage:
+#   CONF_FILE=hf_llama3_8B_config ./train.sh [extra --set overrides...]
+set -o pipefail
+set -e
+
+ulimit -n 65535 2>/dev/null || true
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(dirname "$SCRIPT_DIR")"
+export PYTHONPATH="$REPO_ROOT:${PYTHONPATH:-}"
+
+: "${CONF_FILE:=hf_llama3_8B_config}"
+CONF_FILE_PATH="$SCRIPT_DIR/conf/${CONF_FILE}.yaml"
+if [ ! -f "$CONF_FILE_PATH" ]; then
+    echo "Error: YAML file '$CONF_FILE_PATH' not found!" >&2
+    exit 1
+fi
+
+# Per-restart log dir (reference train_setup.sh:28-29; utils/launch.py
+# restart_log_dir applies the same inside the process for exp_manager paths)
+if [ -n "${SLURM_JOB_ID:-}" ]; then
+    NODEID=${SLURM_NODEID:-0}
+    LOG_PATH=logs/$SLURM_JOB_ID/${SLURM_RESTART_COUNT:-0}/$NODEID
+elif [ -n "${OMPI_COMM_WORLD_RANK:-}" ]; then
+    NODEID=$OMPI_COMM_WORLD_RANK
+    LOG_PATH=logs/mpi/${POD_UID:-run}/$NODEID
+else
+    NODEID=0
+    LOG_PATH=logs/local/$(date "+%Y-%m-%d_%H-%M-%S")
+fi
+mkdir -p "$LOG_PATH"
+
+MAYBE_COMPILE=""
+if [ "${COMPILE:-0}" = "1" ]; then
+    echo "compile-only run (AOT warm-up of the persistent XLA cache)"
+    MAYBE_COMPILE="--compile-only"
+fi
+
+exec python "$SCRIPT_DIR/train.py" \
+    --config "$CONF_FILE_PATH" \
+    $MAYBE_COMPILE \
+    "$@" 2>&1 | tee -a "$LOG_PATH/log"
