@@ -189,10 +189,13 @@ class TestZigzagTrainer:
         assert np.isfinite(m["loss"])
 
     def test_pp_guard(self, tmp_path, devices8):
+        """zigzag + pp is rejected by the load-time catalog (round 3 moved
+        the guard from Trainer.from_config to validate_config — it now dies
+        before any compilation)."""
         from neuronx_distributed_training_tpu.config.loader import load_config
-        from neuronx_distributed_training_tpu.trainer.loop import Trainer
 
-        cfg = load_config({
+        with pytest.raises(ValueError, match="zigzag_ring_attention"):
+            load_config({
             "name": "zzpp", "model_source": "hf", "seed": 3,
             "trainer": {"max_steps": 1},
             "exp_manager": {"exp_dir": str(tmp_path / "exp")},
@@ -208,6 +211,4 @@ class TestZigzagTrainer:
                 "optim": {"lr": 1e-3},
             },
             "precision": {"type": "mixed_precision"},
-        })
-        with pytest.raises(NotImplementedError, match="zigzag"):
-            Trainer.from_config(cfg, enable_checkpointing=False)
+            })
