@@ -148,8 +148,14 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
 
 
 def layer_budget(hbm_bytes: int, bytes_per_param: float, *,
-                 tied: bool = True, util: float = 0.80) -> int:
-    """Estimated deepest Llama-3-8B layer stack fitting ``hbm_bytes``."""
+                 tied: bool = True, util: float = 0.55) -> int:
+    """Estimated deepest Llama-3-8B layer stack fitting ``hbm_bytes``.
+
+    ``util`` is deliberately conservative (0.55): on the tunnelled backend an
+    OOM can WEDGE the chip for hours (round-2 post-mortem), and the driver's
+    capture runs after ours — a too-deep first try can zero the official
+    artifact.  Deeper stacks are probed only under ``--probe-deeper`` in
+    manual sessions."""
     h, ffn, nh, nkv, vocab = 4096, 14336, 32, 8, 128256
     per_layer = h * (nh + 2 * nkv) * (h // nh) + nh * (h // nh) * h + 3 * h * ffn
     vocab_params = (1 if tied else 2) * vocab * h
@@ -319,6 +325,9 @@ def main() -> None:
     ap.add_argument("--untied", action="store_true",
                     help="untie embeddings/head (off the pinned bench config; "
                          "for comparison runs only)")
+    ap.add_argument("--probe-deeper", action="store_true",
+                    help="also try one layer past the HBM estimate (manual "
+                         "sessions only — an OOM can wedge the tunnelled chip)")
     args = ap.parse_args()
 
     dev, backend_err = acquire_device(platform=args.platform)
@@ -376,14 +385,18 @@ def main() -> None:
             cfg = dataclasses.replace(
                 cfg, activations_checkpoint_granularity=(
                     None if args.remat == "none" else args.remat))
-        # deepest-stack search: probe one layer past the estimate (analytic
-        # budgets are conservative), then walk down on OOM.  Config stays
-        # PINNED otherwise — tied embeddings, same shapes, both regimes.
+        # deepest-stack search.  Default (driver-safe): start AT the
+        # conservative estimate and walk DOWN on OOM — never deliberately
+        # over-allocate, an OOM can wedge the tunnelled chip for hours and
+        # zero the driver's own capture (round-2 post-mortem).
+        # --probe-deeper (manual sessions only) additionally tries est+1.
         if args.layers:
             candidates = [args.layers]
         elif on_tpu:
-            candidates = sorted(
-                {est + 1, est, max(1, est - 1), 1}, reverse=True)
+            cand = {est, max(1, est - 1), 1}
+            if args.probe_deeper:
+                cand.add(est + 1)
+            candidates = sorted(cand, reverse=True)
         else:
             candidates = [cfg.num_layers]
         log(f"bench[{name}]: device={dev.device_kind} layer candidates="
