@@ -68,7 +68,15 @@ def main() -> None:
                     help="PP degree of a sharded NNM checkpoint dir")
     args = ap.parse_args()
 
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env even when a sitecustomize pre-imported jax (the env
+        # var alone is read too early to win; see tests/conftest.py) — layout
+        # conversion is host work, CI forces cpu
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import orbax.checkpoint as ocp
 
     from neuronx_distributed_training_tpu.config.loader import load_config
