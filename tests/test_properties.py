@@ -1,0 +1,120 @@
+"""Property-based invariants (hypothesis) for host-side data/layout logic.
+
+These are the pure-Python seams where a shape or ordering bug silently
+corrupts training data: the zig-zag CP permutation, the greedy sequence
+packer (and its C++/numpy parity), fixed-length padding, SLURM nodelist
+parsing, and the microbatch split.  Randomized inputs catch the edge cases
+example-based tests hardcode around.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.data import packing
+from neuronx_distributed_training_tpu.parallel.ring_attention import zigzag_positions
+from neuronx_distributed_training_tpu.utils.launch import expand_first_host
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    cp=st.integers(1, 8),
+    half_chunk=st.integers(1, 16),
+)
+def test_zigzag_positions_is_permutation(cp, half_chunk):
+    s = 2 * cp * half_chunk
+    pos = np.asarray(zigzag_positions(s, cp))
+    assert sorted(pos.tolist()) == list(range(s))
+    # rank r holds chunks (r, 2cp-1-r): first half-chunk of rank 0 is the
+    # lowest chunk, its second half-chunk the highest
+    assert pos[0] == 0
+    assert pos[half_chunk] == (2 * cp - 1) * half_chunk
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=st.data(),
+    chunk_size=st.integers(4, 64),
+    eos_id=st.integers(0, 5),
+)
+def test_pack_sequences_preserves_tokens(data, chunk_size, eos_id):
+    n = data.draw(st.integers(1, 12))
+    seqs = [
+        data.draw(st.lists(st.integers(6, 99), min_size=1, max_size=80))
+        for _ in range(n)
+    ]
+    out = packing.pack_sequences(seqs, chunk_size, eos_id)
+    ids = out["input_ids"]
+    assert ids.ndim == 2 and (ids.shape[1] == chunk_size or ids.size == 0)
+    # every kept record (len+eos <= chunk_size) appears, in order, with its
+    # eos; oversize records are dropped (reference ConcatDataset rule)
+    kept = [s for s in seqs if len(s) + 1 <= chunk_size]
+    flat = ids.reshape(-1).tolist()
+    want: list[int] = []
+    for s in kept:
+        want += list(s) + [eos_id]
+    # remove padding: loss_mask marks real positions
+    mask = out["loss_mask"].reshape(-1).astype(bool)
+    assert [t for t, m in zip(flat, mask) if m] == want
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    data=st.data(),
+    max_length=st.integers(2, 32),
+    left=st.booleans(),
+)
+def test_pad_sequences_shape_and_mask(data, max_length, left):
+    n = data.draw(st.integers(1, 8))
+    seqs = [
+        data.draw(st.lists(st.integers(2, 50), min_size=1, max_size=40))
+        for _ in range(n)
+    ]
+    out = packing.pad_sequences(seqs, max_length, pad_id=0, left_pad=left)
+    assert out["input_ids"].shape == (n, max_length)
+    for i, s in enumerate(seqs):
+        keep = min(len(s), max_length)
+        row = out["input_ids"][i]
+        attn = out["attention_mask"][i]
+        assert int(attn.sum()) == keep
+        if left:
+            assert row[max_length - keep:].tolist() == list(s)[:keep]
+            assert (attn[: max_length - keep] == 0).all()
+        else:
+            assert row[:keep].tolist() == list(s)[:keep]
+            assert (attn[keep:] == 0).all()
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    prefix=st.from_regex(r"[a-z]{1,8}", fullmatch=True),
+    start=st.integers(0, 99),
+    end=st.integers(0, 99),
+    pad=st.integers(1, 3),
+)
+def test_expand_first_host_slurm_ranges(prefix, start, end, pad):
+    lo = min(start, end)
+    hi = max(start, end)
+    nodelist = f"{prefix}[{lo:0{pad}d}-{hi:0{pad}d}]"
+    assert expand_first_host(nodelist) == f"{prefix}{lo:0{pad}d}"
+    # plain comma list -> first entry
+    assert expand_first_host(f"{prefix}7,{prefix}9") == f"{prefix}7"
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    nm=st.integers(1, 8),
+    per=st.integers(1, 4),
+    s=st.integers(1, 8),
+)
+def test_microbatch_split_roundtrip(nm, per, s):
+    from neuronx_distributed_training_tpu.trainer.step import microbatch_split
+
+    batch = {"x": jnp.arange(nm * per * s).reshape(nm * per, s)}
+    mbs = microbatch_split(batch, nm)
+    assert mbs["x"].shape == (nm, per, s)
+    np.testing.assert_array_equal(
+        np.asarray(mbs["x"]).reshape(nm * per, s), np.asarray(batch["x"])
+    )
