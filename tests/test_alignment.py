@@ -54,6 +54,7 @@ class TestLora:
         assert mask["layers"]["attn"]["qkv"]["w"] == 0.0
         assert mask["embed"]["embedding"] == 0.0
 
+    @pytest.mark.slow
     def test_frozen_params_do_not_move(self):
         params = llama.init_params(jax.random.PRNGKey(0), TINY, FP32)
         lparams = add_lora(params, LoraConfig(rank=4), jax.random.PRNGKey(2))

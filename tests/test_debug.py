@@ -139,6 +139,7 @@ class TestTrainStepCollectives:
         assert gathers >= 1, counts      # ZeRO-1 sharded-update re-gather
 
 
+@pytest.mark.slow
 def test_trainer_validate_sharding_gate(tmp_path, devices8):
     """debug.validate_sharding: the trainer asserts param/opt-state layouts at
     build time (and passes on a correct config)."""

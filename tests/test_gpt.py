@@ -178,6 +178,7 @@ class TestGPTMoEFrequency:
             moe_frequency=freq,
         )
 
+    @pytest.mark.slow
     def test_interleaved_structure_and_training(self):
         cfg = self._cfg(2)
         params = gpt.init_params(jax.random.PRNGKey(0), cfg, FP32)

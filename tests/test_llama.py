@@ -230,6 +230,7 @@ def test_cp_ring_matches_single_device(devices8):
         np.testing.assert_allclose(np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_cp_ulysses_matches_single_device(devices8):
     """Context-parallel via Ulysses all-to-all (a TPU-native extension absent
     from the reference): forward + backward must match unsharded numerics."""
