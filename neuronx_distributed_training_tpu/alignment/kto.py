@@ -33,9 +33,10 @@ def compute_reference_logprobs_kto(
     batches: Iterable[dict[str, np.ndarray]],
     forward_logits: ForwardLogits,
 ) -> dict[str, np.ndarray]:
-    """Frozen-policy completion log-probs over the train set -> one column."""
+    """Frozen-policy completion log-probs over the train set (plus the
+    mismatched-KL column when the batches carry ``kl_input_ids``)."""
     parts = list(iter_reference_logprobs_kto(params, batches, forward_logits))
-    return {"reference_logps": np.concatenate([p["reference_logps"] for p in parts])}
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
 
 def iter_reference_logprobs_kto(
@@ -44,19 +45,30 @@ def iter_reference_logprobs_kto(
     forward_logits: ForwardLogits,
 ):
     """Streaming variant of ``compute_reference_logprobs_kto`` (per-batch
-    yield; one shared jit)."""
+    yield; one shared jit).  Batches carrying ``kl_input_ids`` (the
+    mismatched-pair KL estimator) also get a ``reference_kl_logps`` column
+    from the same frozen policy."""
 
     @jax.jit
     def one(params, batch):
+        out = {}
         logits, _reg = _call_forward(
             forward_logits, params, {"input_ids": batch["input_ids"]}
         )
-        return sequence_logprobs(
+        out["reference_logps"] = sequence_logprobs(
             logits, batch["input_ids"], batch.get("loss_mask")
         )
+        if "kl_input_ids" in batch:
+            kl_logits, _ = _call_forward(
+                forward_logits, params, {"input_ids": batch["kl_input_ids"]}
+            )
+            out["reference_kl_logps"] = sequence_logprobs(
+                kl_logits, batch["kl_input_ids"], batch.get("kl_loss_mask")
+            )
+        return out
 
     for batch in batches:
-        yield {"reference_logps": np.asarray(one(params, batch))}
+        yield {k: np.asarray(v) for k, v in one(params, batch).items()}
 
 
 def make_kto_loss_fn(
@@ -65,8 +77,15 @@ def make_kto_loss_fn(
     beta: float = 0.1,
     desirable_weight: float = 1.0,
     undesirable_weight: float = 1.0,
+    kl_estimator: str = "batch_mean",
 ):
-    """Trainer-compatible loss_fn for KTO batches."""
+    """Trainer-compatible loss_fn for KTO batches.
+
+    ``kl_estimator="mismatched"`` runs a second forward over the batch's
+    ``kl_input_ids`` (prompt_i + completion_{i+1}, built by ``KTODataModule``)
+    and uses those rewards as the paper's off-policy z0 baseline (the
+    gradient does not flow through z0, so the extra forward needs no
+    backward — jax only differentiates what reaches the loss)."""
 
     def loss_fn(params, batch, key):
         logits, reg = _call_forward(
@@ -75,10 +94,27 @@ def make_kto_loss_fn(
         logps = sequence_logprobs(
             logits, batch["input_ids"], batch.get("loss_mask")
         )
+        kl_rewards = None
+        if kl_estimator == "mismatched":
+            if "kl_input_ids" not in batch:
+                raise KeyError(
+                    "kl_estimator=mismatched needs kl_input_ids batches — "
+                    "build the data module with kl_estimator='mismatched'"
+                )
+            kl_logits, _ = _call_forward(
+                forward_logits, params,
+                {"input_ids": batch["kl_input_ids"]}, key,
+            )
+            kl_logps = sequence_logprobs(
+                kl_logits, batch["kl_input_ids"], batch.get("kl_loss_mask")
+            )
+            kl_rewards = jax.lax.stop_gradient(
+                beta * (kl_logps - batch["reference_kl_logps"])
+            )
         loss, metrics = kto_loss(
             logps, batch["reference_logps"], batch["kto_labels"],
             beta=beta, desirable_weight=desirable_weight,
-            undesirable_weight=undesirable_weight,
+            undesirable_weight=undesirable_weight, kl_rewards=kl_rewards,
         )
         return loss + reg, metrics
 

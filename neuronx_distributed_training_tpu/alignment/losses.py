@@ -104,6 +104,7 @@ def kto_loss(
     beta: float = 0.1,
     desirable_weight: float = 1.0,
     undesirable_weight: float = 1.0,
+    kl_rewards: jax.Array | None = None,  # [b] mismatched-pair rewards -> z0
 ):
     """KTO (Kahneman-Tversky Optimization, arXiv:2402.01306) for UNPAIRED
     preference data — an extension beyond the reference's DPO/ORPO pair-only
@@ -115,22 +116,19 @@ def kto_loss(
     ``sigmoid(z0 - r)``, with the lambda_D/lambda_U class weights for
     imbalanced feedback.
 
-    .. warning:: **z0 deviates from arXiv:2402.01306 / TRL.**  The paper
-       estimates the KL term from MISMATCHED prompt/completion pairs
-       (shuffle completions within the batch so ``z0 ~ KL(policy||ref)`` on
-       off-policy text); here ``z0`` is the batch-mean reward of the ACTUAL
-       completions (per-microbatch under grad-accum/pipeline).  The loss
-       keeps the paper's shape, but as the policy improves on its own
-       completions the two baselines diverge: this ``z0`` (and the logged
-       ``kto_kl`` metric) grows with the mean reward itself, while the
-       paper's stays an off-policy KL estimate.  Expect ``kto_kl`` readings
-       and late-training gradients to differ from TRL numerically (not
-       directionally).  Shuffled-pair estimation needs cross-example logp
-       recompute per step — a deliberate cost/fidelity trade-off, revisit if
-       KTO parity with TRL matters.
+    .. note:: **Two z0 estimators.**  With ``kl_rewards=None`` (the default
+       ``kl_estimator: batch_mean``), ``z0`` is the batch-mean reward of the
+       ACTUAL completions — cheap (no extra forward) but it deviates from
+       arXiv:2402.01306 / TRL: as the policy improves on its own completions
+       this baseline (and the ``kto_kl`` metric) grows with the mean reward
+       itself instead of staying an off-policy KL estimate.  Pass
+       ``kl_rewards`` (rewards of MISMATCHED (prompt_i, completion_j) pairs;
+       ``kl_estimator: mismatched`` wires it, at the cost of a second
+       forward per step) for the paper's estimator.
     """
     r = beta * (policy_logps - reference_logps)
-    z0 = jax.lax.stop_gradient(jnp.maximum(jnp.mean(r), 0.0))
+    z0_src = r if kl_rewards is None else kl_rewards
+    z0 = jax.lax.stop_gradient(jnp.maximum(jnp.mean(z0_src), 0.0))
     des = labels > 0.5
     value = jnp.where(des, jax.nn.sigmoid(r - z0), jax.nn.sigmoid(z0 - r))
     w = jnp.where(des, desirable_weight, undesirable_weight)

@@ -298,6 +298,14 @@ def validate_config(cfg: ConfigDict) -> None:
                 f"model_alignment_strategy block names none of "
                 f"{'/'.join(_ALIGN)}: got keys {sorted(align)}"
             )
+        kto_blk = dict(align.get("kto") or {})
+        if (str(kto_blk.get("kl_estimator", "batch_mean")) == "mismatched"
+                and pp > 1):
+            raise ValueError(
+                "kto.kl_estimator: mismatched is not supported under pipeline "
+                "parallelism (the KL forward would need its own pipelined "
+                "pass); use the default batch_mean estimator with pp"
+            )
 
 
 def batch_schedule(cfg: ConfigDict, n_devices: int) -> dict[str, int]:

@@ -141,10 +141,15 @@ def build_data_module(
         module_cls = KTODataModule if strategy == "kto" else DPODataModule
 
         def pref(path):
+            extra = {}
+            if strategy == "kto":
+                extra["kl_estimator"] = str(
+                    strat_params.get("kl_estimator", "batch_mean"))
             return module_cls(
                 path, tokenizer, seq, gbs, seed=seed,
                 max_prompt_length=strat_params.get("max_prompt_length"),
                 truncation_mode=str(strat_params.get("truncation_mode", "keep_start")),
+                **extra,
             )
 
         if not train_dir:

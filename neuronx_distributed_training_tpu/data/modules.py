@@ -315,6 +315,7 @@ class KTODataModule(DataModule):
         pad_id: int = 0,
         max_prompt_length: Optional[int] = None,
         truncation_mode: str = "keep_start",
+        kl_estimator: str = "batch_mean",  # "batch_mean" | "mismatched"
         **kw: Any,
     ):
         if isinstance(records, (str, Path)):
@@ -348,6 +349,60 @@ class KTODataModule(DataModule):
             "loss_mask": np.asarray(padded["loss_mask"]),
             "kto_labels": np.asarray(kto_labels, np.float32),
         }
+        if kl_estimator not in ("batch_mean", "mismatched"):
+            raise ValueError(
+                f"kto kl_estimator must be batch_mean or mismatched, "
+                f"got {kl_estimator!r}"
+            )
+        if kl_estimator == "mismatched":
+            # the paper's KL estimate (arXiv:2402.01306 / TRL): rewards of
+            # MISMATCHED (prompt_i, completion_{i+1}) pairs.  A fixed
+            # derangement is an equally valid mismatched sample and lets the
+            # columns be precomputed once (reference logps ride the same
+            # pre-fit pass as the matched column).
+            from neuronx_distributed_training_tpu.data.packing import (
+                IGNORE_INDEX,
+                mask_prompt_labels,
+            )
+
+            n = len(ids_list)
+            if n < 2:
+                raise ValueError(
+                    "kto kl_estimator='mismatched' needs at least 2 records "
+                    "(with 1 the 'mismatched' pair IS the matched pair and "
+                    "the estimator silently degenerates to batch_mean)"
+                )
+            kl_ids, kl_lbl = [], []
+            for i in range(n):
+                j = (i + 1) % n
+                cut_i = next(
+                    (k for k, v in enumerate(lbl_list[i]) if v != IGNORE_INDEX),
+                    len(lbl_list[i]),
+                )
+                cut_j = next(
+                    (k for k, v in enumerate(lbl_list[j]) if v != IGNORE_INDEX),
+                    len(lbl_list[j]),
+                )
+                prompt_i = list(ids_list[i][:cut_i])
+                comp_j = list(ids_list[j][cut_j:])
+                # same keep-completion truncation rule as the matched rows
+                # (_encode_prompt_completion): an overlong splice trims the
+                # PROMPT — tail-truncating comp_j would zero the row's KL
+                # reward and bias z0 toward 0 on long-sequence datasets
+                if len(prompt_i) + len(comp_j) > seq_length:
+                    keep = seq_length - len(comp_j)
+                    if keep <= 0:
+                        prompt_i, comp_j = [], comp_j[-seq_length:]
+                    else:
+                        prompt_i = prompt_i[:keep]
+                ids_kl, lbl_kl = mask_prompt_labels(prompt_i, comp_j)
+                kl_ids.append(ids_kl)
+                kl_lbl.append(lbl_kl)
+            kl_padded = pad_sequences(kl_ids, seq_length, pad_id,
+                                      label_lists=kl_lbl)
+            self.arrays["kl_input_ids"] = np.asarray(kl_padded["input_ids"])
+            self.arrays["kl_loss_mask"] = np.asarray(kl_padded["loss_mask"])
+        self.kl_estimator = kl_estimator
         super().__init__(
             len(records), global_batch_size, shuffle=kw.pop("shuffle", True),
             input_names=tuple(self.arrays), **kw,

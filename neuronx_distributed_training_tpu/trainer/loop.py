@@ -220,6 +220,8 @@ class Trainer:
                         align_params.get("desirable_weight", 1.0)),
                     undesirable_weight=float(
                         align_params.get("undesirable_weight", 1.0)),
+                    kl_estimator=str(
+                        align_params.get("kl_estimator", "batch_mean")),
                 )
             else:
                 from neuronx_distributed_training_tpu.alignment.orpo import make_orpo_loss_fn
@@ -580,10 +582,27 @@ class Trainer:
                 bs = min(dm.global_batch_size, n)
                 done = 0
                 cols: dict[str, np.ndarray] = {}
+                # column set the pass will produce for THIS data module —
+                # a sidecar from a different config (e.g. written under
+                # kto kl_estimator=batch_mean, resumed under mismatched)
+                # must trigger recompute, not a KeyError in the jitted step
+                expected = {_marker}
+                if _marker == "reference_chosen_logps":
+                    expected.add("reference_rejected_logps")
+                if _marker == "reference_logps" and "kl_input_ids" in getattr(
+                        dm, "arrays", {}):
+                    expected.add("reference_kl_logps")
                 loaded = _sidecar_load(sidecar, tag)
                 if loaded is not None:
                     done, cols = loaded
-                    if any(len(v) != n for v in cols.values()):
+                    if set(cols) != expected:
+                        logger.warning(
+                            "%s sidecar %s has columns %s but this config "
+                            "needs %s; recomputing", tag, sidecar,
+                            sorted(cols), sorted(expected),
+                        )
+                        done, cols = 0, {}
+                    elif any(len(v) != n for v in cols.values()):
                         # dataset grew/shrank since the sidecar was written:
                         # stale columns would crash (or silently mis-attach)
                         logger.warning(

@@ -204,3 +204,155 @@ class TestKTO:
         l1, _ = kto_loss(p, ref, labels, beta=0.5, undesirable_weight=1.0)
         l2, _ = kto_loss(p, ref, labels, beta=0.5, undesirable_weight=2.0)
         assert float(l2) > float(l1)
+
+
+class TestKTOMismatchedKL:
+    """kl_estimator: mismatched — the paper's off-policy z0 baseline from
+    (prompt_i, completion_{i+1}) pairs (arXiv:2402.01306 / TRL semantics)."""
+
+    class CharTok:
+        eos_token_id = 1
+        def encode(self, s):
+            return [3 + (ord(c) % 60) for c in s]
+
+    def _records(self, n=8):
+        return [{"prompt": f"pr{i}", "completion": f"answer {i}",
+                 "label": i % 2 == 0} for i in range(n)]
+
+    def test_kl_columns_are_spliced_pairs(self):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+        from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX
+
+        dm = KTODataModule(self._records(), self.CharTok(), seq_length=32,
+                           global_batch_size=4, kl_estimator="mismatched")
+        a = dm.arrays
+        assert "kl_input_ids" in a and "kl_loss_mask" in a
+        n, s = a["input_ids"].shape
+        for i in range(n):
+            j = (i + 1) % n
+            # kl row i = prompt of i (masked) + completion of i+1 (unmasked)
+            prompt_len_i = int(np.argmax(a["loss_mask"][i] > 0))
+            comp_j = a["input_ids"][j][a["loss_mask"][j] > 0]
+            kl_comp = a["kl_input_ids"][i][a["kl_loss_mask"][i] > 0]
+            np.testing.assert_array_equal(kl_comp, comp_j)
+            np.testing.assert_array_equal(
+                a["kl_input_ids"][i][:prompt_len_i],
+                a["input_ids"][i][:prompt_len_i],
+            )
+
+    def test_kl_rewards_change_z0(self):
+        from neuronx_distributed_training_tpu.alignment.losses import kto_loss
+
+        ref = jnp.zeros((4,))
+        labels = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        policy = jnp.asarray([2.0, 2.0, -2.0, -2.0])
+        _, m_batch = kto_loss(policy, ref, labels, beta=0.5)
+        kl = jnp.asarray([0.3, 0.3, 0.3, 0.3])
+        _, m_mis = kto_loss(policy, ref, labels, beta=0.5, kl_rewards=kl)
+        assert abs(float(m_mis["kto_kl"]) - 0.3) < 1e-6
+        assert float(m_batch["kto_kl"]) != float(m_mis["kto_kl"])
+
+    def test_trainer_end_to_end_mismatched(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = load_config({
+            "name": "ktomis", "model_source": "hf", "seed": 5,
+            "trainer": {"max_steps": 2, "log_every_n_steps": 1},
+            "exp_manager": {"exp_dir": str(tmp_path / "exp")},
+            "model_alignment_strategy": {"kto": {"kl_beta": 0.2,
+                                                 "kl_estimator": "mismatched"}},
+            "distributed_strategy": {"tensor_model_parallel_size": 2},
+            "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                     "seq_length": 32, "synthetic": True},
+            "model": {
+                "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+                "num_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "max_position_embeddings": 32,
+                "optim": {"lr": 1e-3,
+                          "sched": {"name": "constant"}},
+            },
+            "precision": {"type": "mixed_precision"},
+        })
+        dm = KTODataModule(self._records(16), self.CharTok(), seq_length=32,
+                           global_batch_size=8, kl_estimator="mismatched")
+        t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+        t.pre_fit(t)
+        assert "reference_kl_logps" in dm.arrays  # pre-fit covered KL pairs
+        m = t.fit()
+        assert np.isfinite(m["loss"])
+        assert "kto_kl" in m
+
+    def test_mismatched_under_pp_rejected(self):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+
+        with pytest.raises(ValueError, match="mismatched"):
+            load_config({
+                "model_alignment_strategy": {
+                    "kto": {"kl_estimator": "mismatched"}},
+                "distributed_strategy": {"pipeline_model_parallel_size": 2},
+                "model": {"num_layers": 2},
+                "data": {"global_batch_size": 4, "micro_batch_size": 1},
+            })
+
+    def test_single_record_mismatched_rejected(self):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        with pytest.raises(ValueError, match="at least 2"):
+            KTODataModule(self._records(1), self.CharTok(), seq_length=32,
+                          global_batch_size=1, kl_estimator="mismatched")
+
+    def test_overlong_splice_keeps_completion(self):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = [{"prompt": "p" * 60, "completion": f"c{i}" * 8,
+                 "label": True} for i in range(4)]
+        dm = KTODataModule(recs, self.CharTok(), seq_length=24,
+                           global_batch_size=2, kl_estimator="mismatched")
+        a = dm.arrays
+        for i in range(4):
+            j = (i + 1) % 4
+            comp_j = a["input_ids"][j][a["loss_mask"][j] > 0]
+            kl_comp = a["kl_input_ids"][i][a["kl_loss_mask"][i] > 0]
+            # the completion survives truncation intact (prompt is trimmed)
+            np.testing.assert_array_equal(kl_comp, comp_j)
+            assert kl_comp.size > 0
+
+    def test_stale_sidecar_column_set_recomputes(self, tmp_path, devices8):
+        """A batch_mean sidecar resumed under mismatched must recompute, not
+        KeyError in the jitted step."""
+        from neuronx_distributed_training_tpu.config.loader import load_config
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        def cfg_for(est):
+            return load_config({
+                "name": "ktostale", "model_source": "hf", "seed": 5,
+                "trainer": {"max_steps": 1, "log_every_n_steps": 1},
+                "exp_manager": {"exp_dir": str(tmp_path / "exp")},
+                "model_alignment_strategy": {"kto": {"kl_beta": 0.2,
+                                                     "kl_estimator": est}},
+                "distributed_strategy": {"tensor_model_parallel_size": 2},
+                "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                         "seq_length": 32, "synthetic": True},
+                "model": {
+                    "vocab_size": 128, "hidden_size": 64,
+                    "intermediate_size": 128, "num_layers": 2,
+                    "num_attention_heads": 4, "num_key_value_heads": 2,
+                    "max_position_embeddings": 32,
+                    "optim": {"lr": 1e-3, "sched": {"name": "constant"}},
+                },
+                "precision": {"type": "mixed_precision"},
+            })
+
+        dm1 = KTODataModule(self._records(8), self.CharTok(), seq_length=32,
+                            global_batch_size=8)
+        t1 = Trainer.from_config(cfg_for("batch_mean"), data_module=dm1)
+        t1.pre_fit(t1)  # writes the batch_mean sidecar (reference_logps only)
+
+        dm2 = KTODataModule(self._records(8), self.CharTok(), seq_length=32,
+                            global_batch_size=8, kl_estimator="mismatched")
+        t2 = Trainer.from_config(cfg_for("mismatched"), data_module=dm2)
+        t2.pre_fit(t2)
+        assert "reference_kl_logps" in dm2.arrays
