@@ -1,0 +1,34 @@
+#!/bin/bash
+# Scripted on-chip measurement session for when the tunnelled TPU heals.
+# ORDER MATTERS: capture a safe number FIRST (an OOM can wedge the chip for
+# hours — round-2 post-mortem), then run diagnostics, then deeper probes.
+# Run from /root/repo:  bash tools/tpu_session.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+OUT=bench_results/tpu_session_$STAMP.log
+exec > >(tee -a "$OUT") 2>&1
+
+echo "== 1. health probe =="
+timeout 180 python -c "
+import time, jax, jax.numpy as jnp
+t0=time.time(); d=jax.devices()
+v=float(jnp.sum(jnp.ones((256,256),jnp.bfloat16) @ jnp.ones((256,256),jnp.bfloat16)))
+print('PROBE_OK', d[0].device_kind, round(time.time()-t0,1), 's')" || {
+  echo "backend still wedged; aborting session"; exit 1; }
+
+echo "== 2. SAFE bench capture (conservative depth, both regimes) =="
+timeout 2400 python bench.py --steps 10 --warmup 3
+
+echo "== 3. EMA donation probe (workaround removal check) =="
+timeout 600 python tools/ema_donation_probe.py
+
+echo "== 4. deeper-stack probe (wedge risk accepted AFTER the capture) =="
+timeout 2400 python bench.py --steps 10 --warmup 3 --probe-deeper
+
+echo "== 5. re-verify health (leave the chip clean for the driver) =="
+timeout 180 python -c "
+import jax, jax.numpy as jnp
+print('FINAL_OK', float(jnp.sum(jnp.ones((256,256),jnp.bfloat16) @ jnp.ones((256,256),jnp.bfloat16))))"
+echo "session complete: $OUT"
