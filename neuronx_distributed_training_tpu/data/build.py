@@ -114,6 +114,7 @@ def build_data_module(
 
         tokenizer = build_tokenizer(data)
         packing = bool(strat_params.get("packing", True))
+        segment_mask = bool(strat_params.get("segment_mask", False))
         n_head = data.get("dev_choose_samples")
         template = build_template(data, tokenizer)
 
@@ -126,7 +127,8 @@ def build_data_module(
             if n_head:
                 records = records[: int(n_head)]
             return SFTDataModule(
-                records, tokenizer, seq, gbs, packing=packing, seed=seed,
+                records, tokenizer, seq, gbs, packing=packing,
+                segment_mask=segment_mask, seed=seed,
                 template=template,
             )
 

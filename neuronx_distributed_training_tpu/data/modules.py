@@ -158,6 +158,7 @@ class SFTDataModule(DataModule):
         global_batch_size: int,
         *,
         packing: bool = True,
+        segment_mask: bool = False,  # block-diagonal attention within chunks
         bos_id: Optional[int] = None,
         eos_id: Optional[int] = None,
         pad_id: int = 0,
@@ -191,7 +192,32 @@ class SFTDataModule(DataModule):
             self.arrays = pack_sequences(
                 ids_list, seq_length, eos_id, label_lists=lbl_list, pad_id=pad_id
             )
+            if segment_mask:
+                # block-diagonal attention within packed chunks (beyond the
+                # reference: ConcatDataset packs WITHOUT masking, records
+                # causally attend across boundaries)
+                from neuronx_distributed_training_tpu.data.packing import (
+                    packed_segment_ids,
+                )
+
+                self.arrays["segment_ids"] = packed_segment_ids(
+                    ids_list, seq_length)
+                # the replay must track pack_sequences' layout exactly — a
+                # future divergence (e.g. a C++-only packing rule change)
+                # must fail loudly, not train with a corrupted mask
+                if (self.arrays["segment_ids"].shape
+                        != self.arrays["input_ids"].shape):
+                    raise AssertionError(
+                        f"packed_segment_ids layout drifted from "
+                        f"pack_sequences: {self.arrays['segment_ids'].shape} "
+                        f"vs {self.arrays['input_ids'].shape}"
+                    )
         else:
+            if segment_mask:
+                raise ValueError(
+                    "sft segment_mask requires packing: true (unpacked rows "
+                    "are single records; the causal mask already isolates them)"
+                )
             padded = pad_sequences(
                 ids_list, seq_length, pad_id, label_lists=lbl_list
             )
@@ -202,7 +228,10 @@ class SFTDataModule(DataModule):
                 f"SFT dataset too small: {n} packed rows < global_batch_size "
                 f"{global_batch_size}"
             )
-        super().__init__(n, global_batch_size, shuffle=kw.pop("shuffle", True), **kw)
+        # input_names drives process_global_batch's filter: segment_ids must
+        # be listed or the loader silently drops it and the mask no-ops
+        super().__init__(n, global_batch_size, shuffle=kw.pop("shuffle", True),
+                         input_names=tuple(self.arrays), **kw)
 
     def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
         return {k: v[idx] for k, v in self.arrays.items()}

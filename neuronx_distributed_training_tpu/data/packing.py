@@ -214,3 +214,43 @@ def mask_prompt_labels(
     ids = list(prompt_tokens) + list(response_tokens)
     lbl = [IGNORE_INDEX] * len(prompt_tokens) + list(response_tokens)
     return ids, lbl
+
+
+def packed_segment_ids(
+    token_lists: Sequence[Sequence[int]], chunk_size: int
+) -> np.ndarray:
+    """Per-position record ids for ``pack_sequences``' chunks: [n, chunk]
+    int32, records numbered 1.. within each chunk, padding 0.
+
+    Replays the packer's deterministic greedy layout from the record lengths
+    (so the C++ and numpy packer paths both stay untouched).  Feed to
+    ``attention(segment_ids=...)`` for block-diagonal packed-sequence masking
+    — the correctness upgrade over the reference's ConcatDataset, whose
+    packed records causally attend across record boundaries.
+    """
+    rows: list[np.ndarray] = []
+    cur: list[int] = []
+    sid = 1
+
+    def flush() -> None:
+        nonlocal sid
+        if not cur:
+            return
+        row = np.zeros(chunk_size, np.int32)
+        row[: len(cur)] = cur
+        rows.append(row)
+        cur.clear()
+        sid = 1
+
+    for toks in token_lists:
+        ln = len(toks) + 1  # + eos, matching pack_sequences
+        if ln > chunk_size:
+            continue  # dropped record
+        if len(cur) + ln > chunk_size:
+            flush()
+        cur.extend([sid] * ln)
+        sid += 1
+    flush()
+    if not rows:
+        return np.zeros((0, chunk_size), np.int32)
+    return np.stack(rows)

@@ -248,7 +248,7 @@ def param_specs(cfg: LlamaConfig, *, pipeline: bool = False):
 
 
 def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
-                     attention_mask=None, return_kv=False):
+                     attention_mask=None, segment_ids=None, return_kv=False):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     if cfg.fuse_qkv:
@@ -271,6 +271,7 @@ def _attention_block(lp, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
         sliding_window=cfg.sliding_window,
         softmax_dtype=policy.softmax_dtype,
         attention_mask=attention_mask,
+        segment_ids=segment_ids,
         block_q=cfg.flash_block_q,
         block_kv=cfg.flash_block_kv,
     )
@@ -290,12 +291,13 @@ def _mlp_block(lp, x):
 
 
 def _decoder_layer(layer_params, x, cos, sin, cfg: LlamaConfig, policy: DtypePolicy,
-                   attention_mask=None, return_kv=False):
+                   attention_mask=None, segment_ids=None, return_kv=False):
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
     residual = x
     hidden = norm_ops.apply_rms_norm(layer_params["input_norm"], x, eps=cfg.rms_norm_eps)
     hidden = _attention_block(layer_params["attn"], hidden, cos, sin, cfg, policy,
-                              attention_mask=attention_mask, return_kv=return_kv)
+                              attention_mask=attention_mask,
+                              segment_ids=segment_ids, return_kv=return_kv)
     kv = None
     if return_kv:
         hidden, kv = hidden
@@ -330,6 +332,7 @@ def hidden_states(
     positions: Optional[jax.Array] = None,
     layers: Optional[Any] = None,  # override stacked layer params (pipeline stages)
     attention_mask: Optional[jax.Array] = None,  # [b, s] 1 = real token
+    segment_ids: Optional[jax.Array] = None,  # [b, s] packed-record segments
 ) -> jax.Array:
     """Embedding + scanned decoder stack + final norm -> [batch, seq, hidden]."""
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
@@ -337,8 +340,9 @@ def hidden_states(
     x = shd.constrain(x, aspec)
 
     if positions is None:
-        # HF position_ids convention for padded batches (see positions_for)
-        positions = positions_for(input_ids, attention_mask)
+        # HF position_ids convention for padded batches (see positions_for);
+        # packed chunks (segment_ids) reset RoPE phases per record
+        positions = positions_for(input_ids, attention_mask, segment_ids)
     inv_freq = rope_ops.rope_frequencies(
         cfg.head_size,
         theta=cfg.rope_theta,
@@ -354,7 +358,8 @@ def hidden_states(
         # ~2 bytes/param of HBM back under mixed precision
         lp = policy.cast_to_compute(lp)
         return _decoder_layer(lp, carry, cos, sin, cfg, policy,
-                              attention_mask=attention_mask), None
+                              attention_mask=attention_mask,
+                              segment_ids=segment_ids), None
 
     remat = _remat_policy(cfg.activations_checkpoint_granularity)
     if remat is not None:
@@ -379,10 +384,25 @@ def logits_fn(params, hidden: jax.Array, cfg: LlamaConfig, policy: DtypePolicy) 
 # ---------------------------------------------------------------------------
 
 
-def positions_for(input_ids: jax.Array, attention_mask=None) -> jax.Array:
+def positions_for(input_ids: jax.Array, attention_mask=None,
+                  segment_ids=None) -> jax.Array:
     """RoPE/absolute position ids [b, s]: plain arange, or — for padded
     batches — the HF convention of counting real tokens only
-    (``cumsum(attention_mask) - 1``), keeping left-padded rows phase-aligned."""
+    (``cumsum(attention_mask) - 1``), keeping left-padded rows phase-aligned.
+    ``segment_ids`` (packed chunks) reset positions at each record start so
+    every packed record sees the RoPE phases it would see unpacked."""
+    if segment_ids is not None:
+        s = input_ids.shape[1]
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        start = jnp.where(
+            jnp.concatenate(
+                [jnp.ones_like(segment_ids[:, :1], dtype=bool),
+                 segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1),
+            idx, 0,
+        )
+        # segments are contiguous runs: running max of start indices
+        start = jax.lax.associative_scan(jnp.maximum, start, axis=1)
+        return idx - start
     if attention_mask is not None:
         m = attention_mask.astype(jnp.int32)
         return jnp.clip(jnp.cumsum(m, axis=1) - 1, 0, None)
@@ -492,8 +512,10 @@ def forward(
     """
     input_ids = batch["input_ids"]
     attention_mask = batch.get("attention_mask")
+    segment_ids = batch.get("segment_ids")
     hidden = hidden_states(params, input_ids, cfg, policy, positions=positions,
-                           attention_mask=attention_mask)
+                           attention_mask=attention_mask,
+                           segment_ids=segment_ids)
     labels = batch.get("labels")
     head_plain = cfg.tie_word_embeddings or (
         "lm_head" in params and "lora_a" not in params["lm_head"]

@@ -119,6 +119,15 @@ def padding_mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array
     return bias[:, None, None, :]
 
 
+def segment_mask_bias(segment_ids: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """``segment_ids`` [b, s] -> additive bias [b, 1, s, s] restricting
+    attention to same-segment (packed-record) pairs — the numerics reference
+    for the flash kernel's block-diagonal segment mask."""
+    neg = jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]
+    return jnp.where(same, jnp.asarray(0, dtype), neg)[:, None, :, :]
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -130,6 +139,7 @@ def attention(
     sliding_window: Optional[int] = None,
     softmax_dtype=jnp.float32,
     attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = attend
+    segment_ids: Optional[jax.Array] = None,  # [b, s] packed-record segments
     block_q: Optional[int] = None,   # Pallas flash tile sizes (None = default;
     block_kv: Optional[int] = None,  # a per-chip tuning knob, fusions.flash_block_*)
 ) -> jax.Array:
@@ -151,6 +161,13 @@ def attention(
             "zigzag_ring does not support attention_mask (padded batches); "
             "use fusions.ring_attention"
         )
+    if segment_ids is not None and impl in ("ring", "ulysses", "zigzag_ring"):
+        # the CP bodies don't implement the block-diagonal segment mask;
+        # a silent core fallback would defeat the CP memory bound — raise
+        raise ValueError(
+            f"segment_ids (packed-sequence masking) is supported by the "
+            f"flash and core paths only, not {impl!r}"
+        )
     if impl == "flash":
         try:
             from neuronx_distributed_training_tpu.ops.flash_attention import flash_attention
@@ -160,7 +177,7 @@ def attention(
             return flash_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window,
                 q_offset=q_offset, attention_mask=attention_mask,
-                block_q=block_q, block_kv=block_kv,
+                segment_ids=segment_ids, block_q=block_q, block_kv=block_kv,
             )
     if impl == "ring":
         try:
@@ -208,6 +225,12 @@ def attention(
                 "ring_attention (contiguous layout) for windowed models"
             )
         return zigzag_ring_attention(q, k, v, causal=causal)
+    bias = None
+    if attention_mask is not None:
+        bias = padding_mask_bias(attention_mask, softmax_dtype)
+    if segment_ids is not None:
+        seg_bias = segment_mask_bias(segment_ids, softmax_dtype)
+        bias = seg_bias if bias is None else bias + seg_bias
     return core_attention(
         q,
         k,
@@ -215,7 +238,6 @@ def attention(
         causal=causal,
         q_offset=q_offset,
         sliding_window=sliding_window,
-        bias=(None if attention_mask is None
-              else padding_mask_bias(attention_mask, softmax_dtype)),
+        bias=bias,
         softmax_dtype=softmax_dtype,
     )

@@ -105,13 +105,13 @@ def _inner_mask(bq, bkv, qi, ki, causal, window, q_offset):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *refs,
-    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked,
+    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked, segmented,
 ):
-    if masked:
-        kvm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        kvm_ref = None
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if masked else None
+    segq_ref = refs.pop(0) if segmented else None
+    segk_ref = refs.pop(0) if segmented else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -125,6 +125,10 @@ def _fwd_kernel(
     if kvm_ref is not None:
         # skip kv blocks that are entirely padding (long pad tails cost 0 MXU)
         vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+    if segq_ref is not None:
+        # packed-chunk segments are contiguous non-decreasing runs: a kv
+        # block strictly ahead of every query segment can't match anything
+        vis = jnp.logical_and(vis, jnp.min(segk_ref[...]) <= jnp.max(segq_ref[...]))
 
     @pl.when(vis)
     def _compute():
@@ -142,6 +146,13 @@ def _fwd_kernel(
             # padded KEYS masked (the HF attention_mask contract) — [1, bkv]
             # broadcasts over query rows
             s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
+        if segq_ref is not None:
+            # block-diagonal packed-sequence mask: attend only within the
+            # same segment ([bq, 1] vs [1, bkv] broadcast)
+            s = jnp.where(
+                segq_ref[...].reshape(-1, 1) == segk_ref[...].reshape(1, -1),
+                s, NEG_INF,
+            )
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -171,9 +182,11 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], SUBLANES))
 
 
-def _fwd_pallas(q, k, v, kvm, *, sm_scale, causal, window, q_offset, bq, bkv, interpret):
+def _fwd_pallas(q, k, v, kvm, seg, *, sm_scale, causal, window, q_offset, bq, bkv,
+                interpret):
     """q [b, nh, sq, d]; k/v [b, nkv, skv, d]; kvm None or [b, skv] int32
-    (1 = real key) -> (o [b, nh, sq, d], lse [b, nh, sq, SUBLANES])."""
+    (1 = real key); seg None or [b, s] int32 segment ids (self-attention
+    packed chunks) -> (o [b, nh, sq, d], lse [b, nh, sq, SUBLANES])."""
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = nh // nkv
@@ -184,6 +197,7 @@ def _fwd_pallas(q, k, v, kvm, *, sm_scale, causal, window, q_offset, bq, bkv, in
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, window=window, q_offset=q_offset,
         bq=bq, bkv=bkv, num_kv=num_kv, masked=kvm is not None,
+        segmented=seg is not None,
     )
     in_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -194,6 +208,12 @@ def _fwd_pallas(q, k, v, kvm, *, sm_scale, causal, window, q_offset, bq, bkv, in
     if kvm is not None:
         in_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
         in_arrays.append(kvm)
+    if seg is not None:
+        # same [b, s] array read twice: query rows and key cols
+        in_specs.append(pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)))
+        in_arrays.append(seg)
+        in_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
+        in_arrays.append(seg)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -226,13 +246,13 @@ def _fwd_pallas(q, k, v, kvm, *, sm_scale, causal, window, q_offset, bq, bkv, in
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked,
+    sm_scale, causal, window, q_offset, bq, bkv, num_kv, masked, segmented,
 ):
-    if masked:
-        kvm_ref, dq_ref, acc_scr = refs
-    else:
-        dq_ref, acc_scr = refs
-        kvm_ref = None
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if masked else None
+    segq_ref = refs.pop(0) if segmented else None
+    segk_ref = refs.pop(0) if segmented else None
+    dq_ref, acc_scr = refs
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -243,6 +263,8 @@ def _dq_kernel(
     vis = _visible(qi, ki, bq, bkv, causal, window, q_offset)
     if kvm_ref is not None:
         vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+    if segq_ref is not None:
+        vis = jnp.logical_and(vis, jnp.min(segk_ref[...]) <= jnp.max(segq_ref[...]))
 
     @pl.when(vis)
     def _compute():
@@ -262,6 +284,11 @@ def _dq_kernel(
             # re-apply the key padding mask — p must be 0 on padded keys or
             # dq leaks gradient through them
             s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
+        if segq_ref is not None:
+            s = jnp.where(
+                segq_ref[...].reshape(-1, 1) == segk_ref[...].reshape(1, -1),
+                s, NEG_INF,
+            )
         # rows with no visible key anywhere carry lse = NEG_INF; exp(s - lse)
         # would be garbage there, so zero them (matches fwd's 0 output)
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
@@ -284,13 +311,13 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-    sm_scale, causal, window, q_offset, bq, bkv, num_q, group, masked,
+    sm_scale, causal, window, q_offset, bq, bkv, num_q, group, masked, segmented,
 ):
-    if masked:
-        kvm_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
-    else:
-        dk_ref, dv_ref, dk_scr, dv_scr = refs
-        kvm_ref = None
+    refs = list(refs)
+    kvm_ref = refs.pop(0) if masked else None
+    segq_ref = refs.pop(0) if segmented else None
+    segk_ref = refs.pop(0) if segmented else None
+    dk_ref, dv_ref, dk_scr, dv_scr = refs
     ki = pl.program_id(2)
     g = pl.program_id(3)
     qi = pl.program_id(4)
@@ -303,6 +330,8 @@ def _dkv_kernel(
     vis = _visible(qi, ki, bq, bkv, causal, window, q_offset)
     if kvm_ref is not None:
         vis = jnp.logical_and(vis, jnp.any(kvm_ref[...] > 0))
+    if segq_ref is not None:
+        vis = jnp.logical_and(vis, jnp.min(segk_ref[...]) <= jnp.max(segq_ref[...]))
 
     @pl.when(vis)
     def _compute():
@@ -320,6 +349,11 @@ def _dkv_kernel(
             s = s + mask
         if kvm_ref is not None:
             s = jnp.where(kvm_ref[...] > 0, s, NEG_INF)
+        if segq_ref is not None:
+            s = jnp.where(
+                segq_ref[...].reshape(-1, 1) == segk_ref[...].reshape(1, -1),
+                s, NEG_INF,
+            )
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bkv]
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
@@ -344,7 +378,7 @@ def _dkv_kernel(
 
 def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpret,
                 dlse=None):
-    q, k, v, kvm, o, lse = res  # q [b, nh, sq, d]; k/v [b, nkv, skv, d]
+    q, k, v, kvm, seg, o, lse = res  # q [b, nh, sq, d]; k/v [b, nkv, skv, d]
     b, nh, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = nh // nkv
@@ -359,8 +393,11 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
     delta = jnp.broadcast_to(delta[..., None], (b, nh, sq, SUBLANES))
 
     common = dict(sm_scale=sm_scale, causal=causal, window=window, q_offset=q_offset,
-                  bq=bq, bkv=bkv, masked=kvm is not None)
+                  bq=bq, bkv=bkv, masked=kvm is not None,
+                  segmented=seg is not None)
     in_arrays = (q, k, v, g, lse, delta) + ((kvm,) if kvm is not None else ())
+    if seg is not None:
+        in_arrays = in_arrays + (seg, seg)
 
     dq_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -371,6 +408,9 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
         pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
     ]
     if kvm is not None:
+        dq_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
+    if seg is not None:
+        dq_specs.append(pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)))
         dq_specs.append(pl.BlockSpec((1, bkv), lambda bi, hi, qi, ki: (bi, ki)))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, num_kv=num_kv, **common),
@@ -397,6 +437,9 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
         pl.BlockSpec((1, 1, bq, SUBLANES), lambda bi, kh, ki, g, qi: (bi, kh * group + g, qi, 0)),
     ]
     if kvm is not None:
+        dkv_specs.append(pl.BlockSpec((1, bkv), lambda bi, kh, ki, g, qi: (bi, ki)))
+    if seg is not None:
+        dkv_specs.append(pl.BlockSpec((1, bq), lambda bi, kh, ki, g, qi: (bi, qi)))
         dkv_specs.append(pl.BlockSpec((1, bkv), lambda bi, kh, ki, g, qi: (bi, ki)))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, num_q=num_q, group=group, **common),
@@ -428,22 +471,22 @@ def _bwd_pallas(res, g, *, sm_scale, causal, window, q_offset, bq, bkv, interpre
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
+def _flash(q, k, v, kvm, seg, causal, window, q_offset, bq, bkv, interpret):
     o, _ = _fwd_pallas(
-        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
-        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+        q, k, v, kvm, seg, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal,
+        window=window, q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
     return o
 
 
-def _flash_fwd(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
+def _flash_fwd(q, k, v, kvm, seg, causal, window, q_offset, bq, bkv, interpret):
     o, lse = _fwd_pallas(
-        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
-        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+        q, k, v, kvm, seg, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal,
+        window=window, q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
-    return o, (q, k, v, kvm, o, lse)
+    return o, (q, k, v, kvm, seg, o, lse)
 
 
 def _mask_cotangent(kvm):
@@ -462,7 +505,7 @@ def _flash_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
         res, g, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
-    return dq, dk, dv, _mask_cotangent(res[3])
+    return dq, dk, dv, _mask_cotangent(res[3]), _mask_cotangent(res[4])
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -471,8 +514,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # -- lse-exposing variant (the ring-attention building block) ----------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_lse(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, kvm, seg, causal, window, q_offset, bq, bkv, interpret):
     """Like ``_flash`` but returns ``(o, lse)`` with lse differentiable.
 
     ``lse [b, nh, sq]`` is the per-row logsumexp of the (scaled, masked)
@@ -482,18 +525,18 @@ def _flash_lse(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
     lse cotangent into the kernel's delta operand.
     """
     o, lse = _fwd_pallas(
-        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
-        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+        q, k, v, kvm, seg, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal,
+        window=window, q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
     return o, lse[..., 0]
 
 
-def _flash_lse_fwd(q, k, v, kvm, causal, window, q_offset, bq, bkv, interpret):
+def _flash_lse_fwd(q, k, v, kvm, seg, causal, window, q_offset, bq, bkv, interpret):
     o, lse = _fwd_pallas(
-        q, k, v, kvm, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
-        q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
+        q, k, v, kvm, seg, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal,
+        window=window, q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret,
     )
-    return (o, lse[..., 0]), (q, k, v, kvm, o, lse)
+    return (o, lse[..., 0]), (q, k, v, kvm, seg, o, lse)
 
 
 def _flash_lse_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
@@ -503,7 +546,7 @@ def _flash_lse_bwd(causal, window, q_offset, bq, bkv, interpret, res, g):
         res, do, sm_scale=1.0 / (q.shape[-1] ** 0.5), causal=causal, window=window,
         q_offset=q_offset, bq=bq, bkv=bkv, interpret=interpret, dlse=dlse,
     )
-    return dq, dk, dv, _mask_cotangent(res[3])
+    return dq, dk, dv, _mask_cotangent(res[3]), _mask_cotangent(res[4])
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -564,8 +607,8 @@ def flash_attention_with_lse(
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     kvm = _prep_mask(attention_mask, b, skv)
-    o, lse = _flash_lse(qt, kt, vt, kvm, causal, sliding_window, q_offset, bq, bkv,
-                        interpret)
+    o, lse = _flash_lse(qt, kt, vt, kvm, None, causal, sliding_window, q_offset,
+                        bq, bkv, interpret)
     return jnp.swapaxes(o, 1, 2), lse
 
 
@@ -578,6 +621,7 @@ def flash_attention(
     sliding_window: Optional[int] = None,
     q_offset: int = 0,
     attention_mask: Optional[jax.Array] = None,  # [b, skv] 1 = real key
+    segment_ids: Optional[jax.Array] = None,  # [b, s] packed-chunk segments
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
@@ -587,6 +631,10 @@ def flash_attention(
     ``attention_mask`` masks padded KEYS (the HF contract, reference
     ``llama_model.py:94-101``) inside the kernel — padded SFT/DPO batches stay
     on the flash path instead of falling back to the O(s^2) core attention.
+    ``segment_ids`` makes attention block-diagonal over packed-chunk segments
+    (tokens attend only within their own record) — a correctness upgrade over
+    the reference's ConcatDataset, whose packed records causally attend
+    ACROSS record boundaries.
     Falls back to ``core_attention`` when shapes don't tile (tiny test models,
     odd head dims) — the dispatch contract of ``ops.attention``.
     ``interpret`` defaults to True off-TPU so tests run on CPU.
@@ -600,11 +648,18 @@ def flash_attention(
         from neuronx_distributed_training_tpu.ops.attention import (
             core_attention,
             padding_mask_bias,
+            segment_mask_bias,
         )
 
+        bias = None
+        if attention_mask is not None:
+            bias = padding_mask_bias(attention_mask)
+        if segment_ids is not None:
+            sb = segment_mask_bias(segment_ids)
+            bias = sb if bias is None else bias + sb
         return core_attention(
             q, k, v, causal=causal, q_offset=q_offset, sliding_window=sliding_window,
-            bias=(None if attention_mask is None else padding_mask_bias(attention_mask)),
+            bias=bias,
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -612,5 +667,19 @@ def flash_attention(
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     kvm = _prep_mask(attention_mask, b, skv)
-    o = _flash(qt, kt, vt, kvm, causal, sliding_window, q_offset, bq, bkv, interpret)
+    seg = None
+    if segment_ids is not None:
+        if sq != skv:
+            raise ValueError(
+                "segment_ids need self-attention (sq == skv); got "
+                f"sq={sq}, skv={skv}"
+            )
+        if segment_ids.shape != (b, sq):
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = ({b}, {sq}); got "
+                f"{segment_ids.shape}"
+            )
+        seg = segment_ids.astype(jnp.int32)
+    o = _flash(qt, kt, vt, kvm, seg, causal, sliding_window, q_offset, bq, bkv,
+               interpret)
     return jnp.swapaxes(o, 1, 2)

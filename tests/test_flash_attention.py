@@ -3,6 +3,7 @@ must match core_attention (the reference-numerics implementation) in
 interpreter mode on CPU (SURVEY.md §4 plan item (a))."""
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 
@@ -163,3 +164,90 @@ def test_flash_masked_with_lse_matches_core():
     # lse finite on real rows, NEG_INF convention respected on any fully
     # masked row (none here — row i always sees key i when i < valid)
     assert jnp.all(jnp.isfinite(lse[:, :, :130]))
+
+
+class TestSegmentedFlash:
+    """segment_ids: block-diagonal packed-sequence masking inside the kernel
+    (a correctness upgrade over the reference's ConcatDataset, whose packed
+    records causally attend ACROSS record boundaries)."""
+
+    def _seg(self, b, s, bounds):
+        import numpy as np
+
+        seg = np.zeros((b, s), np.int32)
+        for bi in range(b):
+            sid = 1
+            prev = 0
+            for cut in bounds[bi] + [s]:
+                seg[bi, prev:cut] = sid
+                sid += 1
+                prev = cut
+        return jnp.asarray(seg)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_segmented_matches_core_fwd_and_grad(self, causal):
+        from neuronx_distributed_training_tpu.ops.attention import (
+            segment_mask_bias,
+        )
+
+        b, s = 2, 256
+        q, k, v = _make_qkv(jax.random.PRNGKey(20), b, s, s, 4, 2, 128)
+        seg = self._seg(b, s, [[100, 180], [37]])
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                                block_q=128, block_kv=128, interpret=True)
+            return jnp.sum(o * o)
+
+        def loss_core(q, k, v):
+            o = core_attention(q, k, v, causal=causal,
+                               bias=segment_mask_bias(seg))
+            return jnp.sum(o * o)
+
+        lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        lc, gc = jax.value_and_grad(loss_core, argnums=(0, 1, 2))(q, k, v)
+        assert jnp.allclose(lf, lc, rtol=2e-4), (lf, lc)
+        for a, b_, name in zip(gf, gc, "qkv"):
+            err = jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9)
+            assert err < 2e-3, f"d{name} rel err {err}"
+
+    def test_no_cross_segment_leak(self):
+        """Changing record 1's tokens must not move record 2's outputs."""
+        b, s = 1, 256
+        q, k, v = _make_qkv(jax.random.PRNGKey(21), b, s, s, 2, 2, 128)
+        seg = self._seg(b, s, [[128]])
+        o1 = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                             block_q=128, block_kv=128, interpret=True)
+        # perturb segment 1 (first 128 positions) of k/v
+        k2 = k.at[:, :128].add(1.0)
+        v2 = v.at[:, :128].add(-1.0)
+        o2 = flash_attention(q, k2, v2, causal=True, segment_ids=seg,
+                             block_q=128, block_kv=128, interpret=True)
+        np.testing.assert_array_equal(np.asarray(o1[:, 128:]),
+                                      np.asarray(o2[:, 128:]))
+        assert not np.allclose(np.asarray(o1[:, :128]), np.asarray(o2[:, :128]))
+
+    def test_segments_compose_with_padding_mask(self):
+        from neuronx_distributed_training_tpu.ops.attention import (
+            padding_mask_bias,
+            segment_mask_bias,
+        )
+
+        b, s = 1, 256
+        q, k, v = _make_qkv(jax.random.PRNGKey(22), b, s, s, 2, 2, 128)
+        seg = self._seg(b, s, [[90]])
+        mask = _pad_mask(b, s, [200])
+        o = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                            attention_mask=mask, block_q=128, block_kv=128,
+                            interpret=True)
+        ref = core_attention(
+            q, k, v, causal=True,
+            bias=padding_mask_bias(mask) + segment_mask_bias(seg))
+        assert jnp.max(jnp.abs(o - ref)) < 1e-4
+
+    def test_cross_attention_segments_rejected(self):
+        q, k, v = _make_qkv(jax.random.PRNGKey(23), 1, 128, 256, 2, 2, 128)
+        with pytest.raises(ValueError, match="self-attention"):
+            flash_attention(q, k, v, causal=False,
+                            segment_ids=jnp.zeros((1, 128), jnp.int32),
+                            interpret=True)
