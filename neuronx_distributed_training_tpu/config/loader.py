@@ -298,15 +298,6 @@ def validate_config(cfg: ConfigDict) -> None:
                 f"model_alignment_strategy block names none of "
                 f"{'/'.join(_ALIGN)}: got keys {sorted(align)}"
             )
-        sft_blk = dict(align.get("sft") or {})
-        if sft_blk.get("segment_mask"):
-            arch = str(model.get("architecture", "llama")).lower()
-            if arch not in ("llama", "mistral"):
-                raise ValueError(
-                    f"sft.segment_mask (packed-sequence attention masking) is "
-                    f"wired for the llama family only; architecture "
-                    f"{arch!r} would silently train without the mask"
-                )
         kto_blk = dict(align.get("kto") or {})
         if (str(kto_blk.get("kl_estimator", "batch_mean")) == "mismatched"
                 and pp > 1):

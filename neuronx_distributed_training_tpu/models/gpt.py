@@ -380,7 +380,7 @@ def _dropout(x, rate, key):
 
 
 def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
-                     return_kv=False):
+                     segment_ids=None, return_kv=False):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_size
     qkv = linear_ops.apply_linear(lp["qkv"], x)
@@ -404,7 +404,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
     out = attn_ops.attention(
         q, k, v, impl=cfg.attention_impl, causal=True,
         sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
-        attention_mask=attention_mask,
+        attention_mask=attention_mask, segment_ids=segment_ids,
     )
     out = linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
     if return_kv:
@@ -429,7 +429,7 @@ def _mlp_block(cfg, lp, x, policy, mid_norm=None):
 
 
 def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
-                   attention_mask=None, return_kv=False):
+                   attention_mask=None, segment_ids=None, return_kv=False):
     """One transformer block in the configured layout
     (reference ``transformer.py:1468-2084``):
 
@@ -450,6 +450,7 @@ def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
         attn_in = _apply_norm(cfg, lp["input_norm"], x)
         attn_out = _attention_block(cfg, lp["attn"], attn_in, cos, sin, policy,
                                     attention_mask=attention_mask,
+                                    segment_ids=segment_ids,
                                     return_kv=return_kv)
         kv = None
         if return_kv:
@@ -467,6 +468,7 @@ def _decoder_layer(cfg, lp, x, cos, sin, policy, dropout_key,
     attn_in = x if bt == "post_ln" else _apply_norm(cfg, lp["input_norm"], x)
     hidden = _attention_block(cfg, lp["attn"], attn_in, cos, sin, policy,
                               attention_mask=attention_mask,
+                              segment_ids=segment_ids,
                               return_kv=return_kv)
     kv = None
     if return_kv:
@@ -527,7 +529,7 @@ def _group_xs(cfg: GPTConfig, layer_stack):
 
 
 def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
-                  layer_keys=None, attention_mask=None):
+                  layer_keys=None, attention_mask=None, segment_ids=None):
     """(xs, body) for the dense/MoE interleave scan over [G] groups.
 
     Shared by ``forward`` and the pipeline ``stage_fn`` (mirrors
@@ -558,7 +560,8 @@ def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
         # per-group cast inside the scan (one group's bf16 copy live at a time)
         mxs = policy.cast_to_compute(mxs)
         x, aux = _decoder_layer(cfg, mxs, x, cos, sin, policy, k0,
-                                attention_mask=attention_mask)
+                                attention_mask=attention_mask,
+                                segment_ids=segment_ids)
 
         def dense_body(carry2, dinp):
             x2, acc2 = carry2
@@ -568,7 +571,8 @@ def _grouped_scan(cfg: GPTConfig, layer_stack, cos, sin, policy,
                 dlp, dk = dinp, None
             dlp = policy.cast_to_compute(dlp)
             x2, a2 = _decoder_layer(cfg, dlp, x2, cos, sin, policy, dk,
-                                    attention_mask=attention_mask)
+                                    attention_mask=attention_mask,
+                                    segment_ids=segment_ids)
             return (x2, acc2 + a2), None
 
         dxs_in = (dxs, keys_g[1:]) if gkeys is not None else dxs
@@ -705,9 +709,10 @@ def forward(
 
     input_ids = batch["input_ids"]
     attention_mask = batch.get("attention_mask")
+    segment_ids = batch.get("segment_ids")
     b, s = input_ids.shape
     aspec = shd.act_spec(cfg.sequence_parallel, False)
-    positions = positions_for(input_ids, attention_mask)
+    positions = positions_for(input_ids, attention_mask, segment_ids)
     x = linear_ops.apply_embedding(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
@@ -731,7 +736,8 @@ def forward(
         # grouped interleave: scan over [L/f] groups of (MoE + f-1 dense)
         xs, body = _grouped_scan(cfg, layer_stack, cos, sin, policy,
                                  layer_keys=layer_keys,
-                                 attention_mask=attention_mask)
+                                 attention_mask=attention_mask,
+                                 segment_ids=segment_ids)
     else:
 
         def body(carry, inp):
@@ -742,7 +748,8 @@ def forward(
                 lp, lkey = inp, None
             lp = policy.cast_to_compute(lp)  # per-layer cast (see llama)
             x, aux = _decoder_layer(cfg, lp, x, cos, sin, policy, lkey,
-                                    attention_mask=attention_mask)
+                                    attention_mask=attention_mask,
+                                    segment_ids=segment_ids)
             return (x, aux_acc + aux), None
 
         xs = (layer_stack, layer_keys) if layer_keys is not None else layer_stack

@@ -152,7 +152,7 @@ def _group_xs(cfg: MixtralConfig, layer_stack):
 
 
 def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
-                  attention_mask=None):
+                  attention_mask=None, segment_ids=None):
     """(xs, body) for the dense/MoE interleave scan over [G] groups.
 
     Shared by ``forward`` and the pipeline ``stage_fn``: each group runs one
@@ -165,12 +165,13 @@ def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
         x, aux_acc = carry
         # per-group cast inside the scan (one group's bf16 copy live at a time)
         x, aux = _decoder_layer(policy.cast_to_compute(gp["moe"]), x, cos, sin,
-                                cfg, policy, attention_mask=attention_mask)
+                                cfg, policy, attention_mask=attention_mask,
+                                segment_ids=segment_ids)
 
         def dense_body(x2, dlp):
             return llama._decoder_layer(
                 policy.cast_to_compute(dlp), x2, cos, sin, lc, policy,
-                attention_mask=attention_mask,
+                attention_mask=attention_mask, segment_ids=segment_ids,
             ), None
 
         x, _ = jax.lax.scan(dense_body, x, gp["dense"])
@@ -180,7 +181,7 @@ def _grouped_scan(cfg: MixtralConfig, layer_stack, cos, sin, policy,
 
 
 def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
-                   attention_mask=None, return_kv=False):
+                   attention_mask=None, segment_ids=None, return_kv=False):
     """Pre-LN attention + MoE block; returns (x, aux_loss[, (k, v)])."""
     lc = cfg.llama
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
@@ -188,6 +189,7 @@ def _decoder_layer(lp, x, cos, sin, cfg: MixtralConfig, policy: DtypePolicy,
     hidden = norm_ops.apply_rms_norm(lp["input_norm"], x, eps=lc.rms_norm_eps)
     hidden = llama._attention_block(lp["attn"], hidden, cos, sin, lc, policy,
                                     attention_mask=attention_mask,
+                                    segment_ids=segment_ids,
                                     return_kv=return_kv)
     kv = None
     if return_kv:
@@ -282,13 +284,15 @@ def forward(
     lc = cfg.llama
     input_ids = batch["input_ids"]
     attention_mask = batch.get("attention_mask")
+    segment_ids = batch.get("segment_ids")
     aspec = shd.act_spec(lc.sequence_parallel, lc.context_parallel)
     x = linear_ops.apply_embedding(
         params["embed"], input_ids, compute_dtype=policy.compute_dtype
     )
     x = shd.constrain(x, aspec)
     cos, sin = llama._rope_for(
-        input_ids, lc, positions=llama.positions_for(input_ids, attention_mask)
+        input_ids, lc,
+        positions=llama.positions_for(input_ids, attention_mask, segment_ids)
     )
     layer_stack = params["layers"]
     remat = llama._remat_policy(lc.activations_checkpoint_granularity)
@@ -299,14 +303,16 @@ def forward(
             x, aux_acc = carry
             lp = policy.cast_to_compute(lp)  # per-layer cast (see llama)
             x, aux = _decoder_layer(lp, x, cos, sin, cfg, policy,
-                                    attention_mask=attention_mask)
+                                    attention_mask=attention_mask,
+                                    segment_ids=segment_ids)
             return (x, aux_acc + aux), None
 
         xs = layer_stack
     else:
         # grouped interleave: scan over [L/f] groups of (MoE + f-1 dense)
         xs, body = _grouped_scan(cfg, layer_stack, cos, sin, policy,
-                                 attention_mask=attention_mask)
+                                 attention_mask=attention_mask,
+                                 segment_ids=segment_ids)
 
     if remat is not None:
         body = jax.checkpoint(body, policy=remat, prevent_cse=False)

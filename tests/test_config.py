@@ -264,7 +264,3 @@ class TestValidationCatalog:
         self._expect("config ROOT",
                      **{"model.model_alignment_strategy": "dpo"})
 
-    def test_segment_mask_non_llama_rejected(self):
-        self._expect("llama family",
-                     **{"model_alignment_strategy.sft.segment_mask": True,
-                        "model.architecture": "gpt"})

@@ -425,3 +425,42 @@ class TestSegmentMaskedPacking:
         unmasked_a = logits(ids, None)[:, 8:]
         unmasked_b = logits(ids2, None)[:, 8:]
         assert not np.allclose(unmasked_a, unmasked_b)
+
+    @pytest.mark.parametrize("family", ["gpt", "mixtral"])
+    def test_no_cross_record_leak_gpt_and_mixtral(self, family):
+        """segment masking is wired through every model family, not just
+        llama (each was initially llama-only and silently unmasked)."""
+        from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+        fp32 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                           softmax_dtype=jnp.float32)
+        if family == "gpt":
+            from neuronx_distributed_training_tpu.models import gpt as mod
+
+            cfg = mod.GPTConfig(
+                vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_position_embeddings=32,
+                activations_checkpoint_granularity=None,
+            )
+        else:
+            from neuronx_distributed_training_tpu.models import mixtral as mod
+            from neuronx_distributed_training_tpu.ops import moe as moe_ops
+
+            cfg = mod.MixtralConfig.from_config({
+                "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+                "num_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "max_position_embeddings": 32,
+                "moe": {"num_experts": 2, "top_k": 1, "dropless": True},
+                "activations_checkpoint_granularity": None,
+            })
+        params = mod.init_params(jax.random.PRNGKey(0), cfg, fp32)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 3, 64)
+        ids2 = ids.at[:, :8].add(1)
+        seg = jnp.asarray([[1] * 8 + [2] * 8])
+
+        def logits(i):
+            out, _ = mod.forward(params, {"input_ids": i, "segment_ids": seg},
+                                 cfg, fp32)
+            return np.asarray(out)
+
+        np.testing.assert_array_equal(logits(ids)[:, 8:], logits(ids2)[:, 8:])
