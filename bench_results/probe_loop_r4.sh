@@ -1,0 +1,16 @@
+#!/bin/bash
+# Poll the tunnelled TPU backend until it answers a tiny matmul with a value fetch.
+LOG=/root/repo/bench_results/probe_r4.log
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+  timeout 180 env PYTHONPATH=/root/.axon_site python -c "
+import time, jax, jax.numpy as jnp
+t0=time.time()
+d = jax.devices()
+x = jnp.ones((256,256), jnp.bfloat16)
+v = float(jnp.sum(x @ x))
+print('PROBE_OK', d[0].platform, d[0].device_kind, round(time.time()-t0,1))
+" >> "$LOG" 2>&1
+  if grep -q PROBE_OK "$LOG"; then echo "BACKEND HEALTHY at $(date -u +%H:%M:%S)" >> "$LOG"; exit 0; fi
+  sleep 240
+done

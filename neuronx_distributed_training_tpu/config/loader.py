@@ -243,6 +243,29 @@ def validate_config(cfg: ConfigDict) -> None:
             f"divisible by tp*cp = {tp}*{cp} (use ring_attention when cp "
             f"exceeds the head budget)"
         )
+    if cp > 1 and pp > 1 and cp_aware and seq is not None:
+        # CP under PP routes attention to blockwise_gspmd_attention (the
+        # nested-shard_map backward hazard), whose kv block must divide the
+        # GLOBAL sequence; a non-smooth length degrades to a tiny block and
+        # an s/bkv-step scan.  Seq len is static in every config, so reject
+        # the cliff here instead of warning at trace time.
+        from neuronx_distributed_training_tpu.parallel.ring_attention import (
+            pick_bkv,
+        )
+
+        # same knob the kernels receive: fusions.flash_block_kv (threaded by
+        # ops.attention to ring/ulysses, blockwise default 512 when unset)
+        want = int(fusions.get("flash_block_kv") or 512)
+        s = int(seq)
+        bkv, degraded = pick_bkv(s, want)
+        if degraded:
+            raise ValueError(
+                f"context-parallel-under-pipeline attention needs "
+                f"data.seq_length={s} to have a divisor near the kv block "
+                f"size {want} (largest available: {bkv}, an {s // bkv}-step "
+                f"scan with pathological compile/step time); pad seq_length "
+                f"to a smoother length (e.g. a multiple of {want})"
+            )
 
     # ---- megatron block layout -------------------------------------------
     bt = model.get("transformer_block_type")
@@ -305,6 +328,15 @@ def validate_config(cfg: ConfigDict) -> None:
                 "kto.kl_estimator: mismatched is not supported under pipeline "
                 "parallelism (the KL forward would need its own pipelined "
                 "pass); use the default batch_mean estimator with pp"
+            )
+        sft_blk = dict(align.get("sft") or {})
+        if sft_blk.get("segment_mask") and (cp > 1 or cp_aware):
+            raise ValueError(
+                "sft.segment_mask: true (block-diagonal attention inside "
+                "packed rows) is supported by the flash and core attention "
+                "paths only — not under context parallelism "
+                f"(context_parallel_size={cp} / ring, ulysses or zigzag "
+                "fusions); disable the CP fusion or segment_mask"
             )
 
 

@@ -11,6 +11,7 @@ build).
 from __future__ import annotations
 
 import json
+import warnings
 from pathlib import Path
 from typing import Any, Optional, Sequence
 
@@ -323,6 +324,69 @@ class DPODataModule(DataModule):
             yield self.fetch_rows(idx)
 
 
+def _mismatched_pairing(prompts: Sequence[tuple], rng) -> list[int]:
+    """Seeded pairing ``i -> j`` for KTO's mismatched-KL estimator: each
+    record borrows the completion of a record with a DIFFERENT prompt.
+
+    Records are grouped by prompt tokens, seeded-shuffled within and among
+    groups, laid out group-contiguously (largest group first), and paired by
+    a cyclic shift of the largest group's size: a block of size ``m_i <= m1``
+    shifted by ``m1`` can only land back on itself via wraparound, which
+    needs ``m_i + m1 > n`` — so whenever the largest group fits in half the
+    dataset the result is a BIJECTION with zero matched pairs (every
+    completion weighs into the z0 baseline exactly once).  If one prompt
+    owns more than half the records no such bijection exists (Hall), and the
+    pairing falls back to walking a shuffled cyclic order past same-prompt
+    records — not injective, but still free of matched pairs — with a
+    warning.  All-identical prompts degenerate to the cyclic successor
+    (warned: the estimator then approximates batch_mean).
+    """
+    n = len(prompts)
+    groups: dict[tuple, list[int]] = {}
+    for i, p in enumerate(prompts):
+        groups.setdefault(p, []).append(i)
+    if len(groups) == 1:
+        warnings.warn(
+            "kto kl_estimator='mismatched': every record shares one "
+            "prompt, so no truly mismatched pair exists — the KL "
+            "baseline degenerates toward batch_mean",
+            stacklevel=3,
+        )
+        order = rng.permutation(n)
+        pos = np.empty(n, dtype=np.int64)
+        pos[order] = np.arange(n)
+        return [int(order[(pos[i] + 1) % n]) for i in range(n)]
+    glist = list(groups.values())
+    for g in glist:
+        rng.shuffle(g)
+    rng.shuffle(glist)
+    glist.sort(key=len, reverse=True)  # stable: random tiebreak survives
+    m1 = len(glist[0])
+    flat = [i for g in glist for i in g]
+    if 2 * m1 <= n:
+        pair = [0] * n
+        for p, i in enumerate(flat):
+            pair[i] = flat[(p + m1) % n]
+        return pair
+    warnings.warn(
+        f"kto kl_estimator='mismatched': one prompt owns {m1} of {n} "
+        f"records, so no one-to-one mismatched pairing exists — falling "
+        f"back to a non-injective pairing (some completions weigh more "
+        f"than once in the z0 KL baseline)",
+        stacklevel=3,
+    )
+    order = rng.permutation(n)
+    pos = np.empty(n, dtype=np.int64)
+    pos[order] = np.arange(n)
+    pair = []
+    for i in range(n):
+        j = int(order[(pos[i] + 1) % n])
+        while prompts[j] == prompts[i]:
+            j = int(order[(pos[j] + 1) % n])
+        pair.append(j)
+    return pair
+
+
 class KTODataModule(DataModule):
     """KTO unpaired preference data: single (prompt, completion, label)
     records (arXiv:2402.01306) — an extension beyond the reference's
@@ -385,10 +449,15 @@ class KTODataModule(DataModule):
             )
         if kl_estimator == "mismatched":
             # the paper's KL estimate (arXiv:2402.01306 / TRL): rewards of
-            # MISMATCHED (prompt_i, completion_{i+1}) pairs.  A fixed
-            # derangement is an equally valid mismatched sample and lets the
-            # columns be precomputed once (reference logps ride the same
-            # pre-fit pass as the matched column).
+            # MISMATCHED (prompt_i, completion_j) pairs.  The pairing is a
+            # SEEDED prompt-group-aware derangement (_mismatched_pairing),
+            # not a fixed (i+1)%n shift: KTO files commonly list several
+            # completions per prompt consecutively, and a fixed shift would
+            # pair prompt_i with an on-policy completion — a matched pair —
+            # biasing the z0 baseline toward the on-policy mean (TRL
+            # shuffles its KL pairs for the same reason).  The columns are
+            # still precomputed once (reference logps ride the same pre-fit
+            # pass as the matched column).
             from neuronx_distributed_training_tpu.data.packing import (
                 IGNORE_INDEX,
                 mask_prompt_labels,
@@ -401,19 +470,24 @@ class KTODataModule(DataModule):
                     "(with 1 the 'mismatched' pair IS the matched pair and "
                     "the estimator silently degenerates to batch_mean)"
                 )
+            cuts = [
+                next((k for k, v in enumerate(lbl) if v != IGNORE_INDEX),
+                     len(lbl))
+                for lbl in lbl_list
+            ]
+            # group by the RAW encoded prompt, not the truncated row prefix:
+            # overlong rows trim the prompt by their own completion's length
+            # (_encode_prompt_completion), so two records sharing a prompt
+            # can carry different row prefixes — keying on those would pair
+            # them together, a matched pair in disguise
+            prompts = [tuple(encode(r["prompt"])) for r in records]
+            rng = np.random.default_rng(int(kw.get("seed", 1234)))
+            pair = _mismatched_pairing(prompts, rng)
             kl_ids, kl_lbl = [], []
             for i in range(n):
-                j = (i + 1) % n
-                cut_i = next(
-                    (k for k, v in enumerate(lbl_list[i]) if v != IGNORE_INDEX),
-                    len(lbl_list[i]),
-                )
-                cut_j = next(
-                    (k for k, v in enumerate(lbl_list[j]) if v != IGNORE_INDEX),
-                    len(lbl_list[j]),
-                )
-                prompt_i = list(ids_list[i][:cut_i])
-                comp_j = list(ids_list[j][cut_j:])
+                j = pair[i]
+                prompt_i = list(ids_list[i][: cuts[i]])
+                comp_j = list(ids_list[j][cuts[j]:])
                 # same keep-completion truncation rule as the matched rows
                 # (_encode_prompt_completion): an overlong splice trims the
                 # PROMPT — tail-truncating comp_j would zero the row's KL

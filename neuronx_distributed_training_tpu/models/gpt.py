@@ -77,6 +77,8 @@ class GPTConfig:
     share_embeddings_and_output_weights: bool = True  # Megatron default tying
     initializer_range: float = 0.02
     attention_impl: str = "core"
+    flash_block_q: Optional[int] = None   # Pallas tile knobs, fusions.flash_block_*
+    flash_block_kv: Optional[int] = None  # (also the blockwise/ring kv block)
     sequence_parallel: bool = False
     activations_checkpoint_granularity: Optional[str] = "selective"
     # MoE (NeuronSwitchMLP equivalent); None -> dense
@@ -136,6 +138,8 @@ class GPTConfig:
                 m.get("share_embeddings_and_output_weights", True)
             ),
             attention_impl="flash" if fusions.get("flash_attention") else "core",
+            flash_block_q=fusions.get("flash_block_q"),
+            flash_block_kv=fusions.get("flash_block_kv"),
             sequence_parallel=bool(ds.get("sequence_parallel", False)),
             activations_checkpoint_granularity=m.get(
                 "activations_checkpoint_granularity", "selective"
@@ -405,6 +409,7 @@ def _attention_block(cfg, lp, x, cos, sin, policy, attention_mask=None,
         q, k, v, impl=cfg.attention_impl, causal=True,
         sliding_window=cfg.sliding_window, softmax_dtype=policy.softmax_dtype,
         attention_mask=attention_mask, segment_ids=segment_ids,
+        block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
     )
     out = linear_ops.apply_linear(lp["o"], out.reshape(b, s, nh * d))
     if return_kv:

@@ -192,7 +192,7 @@ def attention(
                 )
             return ring_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window,
-                attention_mask=attention_mask,
+                block_kv=block_kv or 512, attention_mask=attention_mask,
             )
     if impl == "ulysses":
         try:
@@ -207,7 +207,7 @@ def attention(
                 )
             return ulysses_attention(
                 q, k, v, causal=causal, sliding_window=sliding_window,
-                attention_mask=attention_mask,
+                block_kv=block_kv or 512, attention_mask=attention_mask,
             )
     if impl == "zigzag_ring":
         from neuronx_distributed_training_tpu.parallel.ring_attention import (

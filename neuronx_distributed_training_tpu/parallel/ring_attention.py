@@ -266,6 +266,17 @@ def in_manual_region() -> bool:
                 and any("Manual" in str(t) for t in cur.axis_types))
 
 
+def pick_bkv(s: int, block_kv: int) -> tuple[int, bool]:
+    """Largest divisor of ``s`` no bigger than ``block_kv``, and whether the
+    choice is degraded (>8x smaller than asked — an s/bkv-step scan).  Shared
+    by ``blockwise_gspmd_attention`` and the config-validation catalog so the
+    load-time rejection can never drift from the trace-time selection."""
+    bkv = max(1, min(block_kv, s))
+    while s % bkv:
+        bkv -= 1
+    return bkv, bkv * 8 < min(block_kv, s)
+
+
 def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
                               block_kv: int = 512, attention_mask=None):
     """Memory-bounded global attention with NO explicit collectives.
@@ -283,10 +294,8 @@ def blockwise_gspmd_attention(q, k, v, *, causal=True, sliding_window=None,
     # largest divisor of s <= block_kv: _chunk_update's non-divisible
     # fallback collapses to ONE block, which at the full global sequence
     # would be an O(s^2) score tensor — exactly what this body must bound
-    bkv = max(1, min(block_kv, s))
-    while s % bkv:
-        bkv -= 1
-    if bkv * 8 < min(block_kv, s) and (s, block_kv) not in _warned_bkv:
+    bkv, degraded = pick_bkv(s, block_kv)
+    if degraded and (s, block_kv) not in _warned_bkv:
         # a non-smooth sequence length (e.g. prime s) degrades to a tiny bkv
         # and an s/bkv-step scan with pathological compile/step time — make
         # the cliff loud instead of silent (ADVICE r2), once per shape

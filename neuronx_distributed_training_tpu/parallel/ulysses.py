@@ -84,6 +84,7 @@ def ulysses_attention(
     sliding_window: Optional[int] = None,
     axis_name: str = "context",
     mesh=None,
+    block_kv: int = 512,
     attention_mask: Optional[jax.Array] = None,  # [b, s] 1 = real key
 ) -> jax.Array:
     """All-to-all context-parallel attention over the active mesh.
@@ -118,7 +119,7 @@ def ulysses_attention(
         # attention runs the GSPMD blockwise body instead
         return blockwise_gspmd_attention(
             q, k, v, causal=causal, sliding_window=sliding_window,
-            attention_mask=attention_mask,
+            block_kv=block_kv, attention_mask=attention_mask,
         )
 
     h, kvh = q.shape[2], k.shape[2]

@@ -219,18 +219,32 @@ class TestKTOMismatchedKL:
         return [{"prompt": f"pr{i}", "completion": f"answer {i}",
                  "label": i % 2 == 0} for i in range(n)]
 
+    @staticmethod
+    def _paired_indices(a):
+        """Recover which record each kl row borrowed its completion from by
+        matching completion tokens (pairing is a seeded derangement now, not
+        a fixed shift)."""
+        n = a["input_ids"].shape[0]
+        comps = [tuple(a["input_ids"][j][a["loss_mask"][j] > 0])
+                 for j in range(n)]
+        pairs = []
+        for i in range(n):
+            kl_comp = tuple(a["kl_input_ids"][i][a["kl_loss_mask"][i] > 0])
+            pairs.append(comps.index(kl_comp))
+        return pairs
+
     def test_kl_columns_are_spliced_pairs(self):
         from neuronx_distributed_training_tpu.data.modules import KTODataModule
-        from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX
 
         dm = KTODataModule(self._records(), self.CharTok(), seq_length=32,
                            global_batch_size=4, kl_estimator="mismatched")
         a = dm.arrays
         assert "kl_input_ids" in a and "kl_loss_mask" in a
         n, s = a["input_ids"].shape
-        for i in range(n):
-            j = (i + 1) % n
-            # kl row i = prompt of i (masked) + completion of i+1 (unmasked)
+        pairs = self._paired_indices(a)
+        for i, j in enumerate(pairs):
+            # kl row i = prompt of i (masked) + completion of some j!=i
+            assert j != i, "mismatched pairing must be a derangement"
             prompt_len_i = int(np.argmax(a["loss_mask"][i] > 0))
             comp_j = a["input_ids"][j][a["loss_mask"][j] > 0]
             kl_comp = a["kl_input_ids"][i][a["kl_loss_mask"][i] > 0]
@@ -239,6 +253,83 @@ class TestKTOMismatchedKL:
                 a["kl_input_ids"][i][:prompt_len_i],
                 a["input_ids"][i][:prompt_len_i],
             )
+        # every completion is used exactly once (cyclic derangement)
+        assert sorted(pairs) == list(range(n))
+
+    def test_pairing_is_seeded_and_deterministic(self):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        mk = lambda seed: KTODataModule(
+            self._records(16), self.CharTok(), seq_length=32,
+            global_batch_size=4, kl_estimator="mismatched", seed=seed)
+        a1, a2 = mk(7).arrays, mk(7).arrays
+        np.testing.assert_array_equal(a1["kl_input_ids"], a2["kl_input_ids"])
+        a3 = mk(8).arrays
+        assert not np.array_equal(a1["kl_input_ids"], a3["kl_input_ids"])
+
+    def test_repeated_prompts_never_pair_matched(self):
+        """Several completions per prompt listed consecutively (the common
+        KTO file layout) must not yield an effectively matched KL pair."""
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = []
+        for p in range(4):
+            for c in range(4):  # 4 consecutive completions per prompt
+                recs.append({"prompt": f"prompt {p}",
+                             "completion": f"ans {p}-{c}", "label": c % 2})
+        dm = KTODataModule(recs, self.CharTok(), seq_length=48,
+                           global_batch_size=4, kl_estimator="mismatched")
+        a = dm.arrays
+        enc = self.CharTok().encode
+        prompt_of = [tuple(enc(r["prompt"])) for r in recs]
+        pairs = self._paired_indices(a)
+        for i, j in enumerate(pairs):
+            assert prompt_of[j] != prompt_of[i], (
+                f"kl row {i} paired with token-identical prompt {j}")
+        # largest group (4) fits in half the dataset (16) -> a bijection:
+        # every completion weighs into the z0 baseline exactly once
+        assert sorted(pairs) == list(range(len(recs)))
+
+    def test_majority_prompt_falls_back_non_injective(self):
+        """One prompt owning > n/2 records: no bijection avoiding matched
+        pairs exists (Hall) — the pairing warns and stays matched-pair-free."""
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = [{"prompt": "big", "completion": f"b{i}", "label": True}
+                for i in range(6)]
+        recs += [{"prompt": "other", "completion": f"o{i}", "label": False}
+                 for i in range(2)]
+        with pytest.warns(UserWarning, match="no one-to-one"):
+            dm = KTODataModule(recs, self.CharTok(), seq_length=32,
+                               global_batch_size=4, kl_estimator="mismatched")
+        enc = self.CharTok().encode
+        prompt_of = [tuple(enc(r["prompt"])) for r in recs]
+        for i, j in enumerate(self._paired_indices(dm.arrays)):
+            assert prompt_of[j] != prompt_of[i]
+
+    def test_all_identical_prompts_warns(self):
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = [{"prompt": "same", "completion": f"c{i}", "label": True}
+                for i in range(4)]
+        with pytest.warns(UserWarning, match="shares one prompt"):
+            KTODataModule(recs, self.CharTok(), seq_length=32,
+                          global_batch_size=2, kl_estimator="mismatched")
+
+    def test_grouping_keys_on_raw_prompt_not_truncated_prefix(self):
+        """Overlong rows trim the prompt by their own completion's length, so
+        two records sharing a prompt can carry different row prefixes — the
+        pairing must still see ONE prompt group (here: the all-identical
+        degenerate warning), not distinct groups it could pair together."""
+        from neuronx_distributed_training_tpu.data.modules import KTODataModule
+
+        recs = [
+            {"prompt": "p" * 60, "completion": "c" * 4, "label": True},
+            {"prompt": "p" * 60, "completion": "d" * 12, "label": False},
+        ]
+        with pytest.warns(UserWarning, match="shares one prompt"):
+            KTODataModule(recs, self.CharTok(), seq_length=24,
+                          global_batch_size=2, kl_estimator="mismatched")
 
     def test_kl_rewards_change_z0(self):
         from neuronx_distributed_training_tpu.alignment.losses import kto_loss
@@ -308,11 +399,11 @@ class TestKTOMismatchedKL:
 
         recs = [{"prompt": "p" * 60, "completion": f"c{i}" * 8,
                  "label": True} for i in range(4)]
-        dm = KTODataModule(recs, self.CharTok(), seq_length=24,
-                           global_batch_size=2, kl_estimator="mismatched")
+        with pytest.warns(UserWarning, match="shares one prompt"):
+            dm = KTODataModule(recs, self.CharTok(), seq_length=24,
+                               global_batch_size=2, kl_estimator="mismatched")
         a = dm.arrays
-        for i in range(4):
-            j = (i + 1) % 4
+        for i, j in enumerate(self._paired_indices(a)):
             comp_j = a["input_ids"][j][a["loss_mask"][j] > 0]
             kl_comp = a["kl_input_ids"][i][a["kl_loss_mask"][i] > 0]
             # the completion survives truncation intact (prompt is trimmed)

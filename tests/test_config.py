@@ -264,3 +264,39 @@ class TestValidationCatalog:
         self._expect("config ROOT",
                      **{"model.model_alignment_strategy": "dpo"})
 
+    def test_segment_mask_under_cp_rejected(self):
+        self._expect("segment_mask",
+                     **{"model_alignment_strategy.sft.segment_mask": True,
+                        "distributed_strategy.context_parallel_size": 2,
+                        "model.fusions.ring_attention": True})
+
+    def test_segment_mask_with_cp_fusion_rejected(self):
+        # cp == 1 but a CP fusion enabled still trips the trace-time path
+        self._expect("segment_mask",
+                     **{"model_alignment_strategy.sft.segment_mask": True,
+                        "model.fusions.ulysses_attention": True})
+
+    def test_segment_mask_flash_only_passes(self):
+        load_config(self._base(
+            **{"model_alignment_strategy.sft.segment_mask": True,
+               "model_alignment_strategy.sft.packing": True,
+               "model.fusions.flash_attention": True}))
+
+    def test_blockwise_cp_under_pp_nonsmooth_seq_rejected(self):
+        # prime-ish seq len under CP x PP would degrade the blockwise body to
+        # a tiny kv block and an s-step scan — must die at load time
+        self._expect("smoother length",
+                     **{"distributed_strategy.context_parallel_size": 2,
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "model.fusions.ring_attention": True,
+                        "model.num_layers": 4,
+                        "data.seq_length": 2 * 1019})  # 2038 = 2 x prime
+
+    def test_blockwise_cp_under_pp_smooth_seq_passes(self):
+        load_config(self._base(
+            **{"distributed_strategy.context_parallel_size": 2,
+               "distributed_strategy.pipeline_model_parallel_size": 2,
+               "model.fusions.ring_attention": True,
+               "model.num_layers": 4,
+               "data.seq_length": 2048}))
+
