@@ -618,7 +618,6 @@ def pipeline_hooks(cfg: GPTConfig, policy: DtypePolicy, *, shift_labels: bool = 
         s = ids.shape[1]
         x = linear_ops.apply_embedding(
             params["embed"], ids, compute_dtype=policy.compute_dtype,
-            via_matmul=True,
         )
         if cfg.position_embedding_type == "learned_absolute":
             x = x + jnp.take(

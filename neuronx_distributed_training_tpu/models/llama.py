@@ -432,11 +432,8 @@ def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy, *, shift_labels: bool 
     aspec = shd.act_spec(cfg.sequence_parallel, cfg.context_parallel)
 
     def embed_fn(params, mb):
-        # via_matmul: no scatter in backward (see ops.linear.apply_embedding —
-        # the manual-pipe + ZeRO-1 partitioner crash)
         x = linear_ops.apply_embedding(
             params["embed"], mb["input_ids"], compute_dtype=policy.compute_dtype,
-            via_matmul=True,
         )
         return shd.constrain(x, aspec)
 

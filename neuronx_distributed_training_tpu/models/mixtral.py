@@ -224,7 +224,6 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
     def embed_fn(params, mb):
         x = linear_ops.apply_embedding(
             params["embed"], mb["input_ids"], compute_dtype=policy.compute_dtype,
-            via_matmul=True,
         )
         return shd.constrain(x, aspec)
 

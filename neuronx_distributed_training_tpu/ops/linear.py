@@ -104,10 +104,13 @@ def apply_embedding(params, ids: jax.Array, *, compute_dtype=None,
     """Embedding lookup.
 
     ``via_matmul`` computes ``one_hot(ids) @ table`` instead of a gather: the
-    backward pass is then a ``dot_general`` rather than a scatter-add.  Used by
-    the pipeline hooks — XLA's SPMD partitioner CHECK-crashes partitioning the
-    gather-transpose scatter when its consumer is DP-resharded (ZeRO-1 moments)
-    inside the manual ``pipe`` submesh (spmd_partitioner_util.cc:495).  With a
+    backward pass is then a ``dot_general`` rather than a scatter-add.  XLA's
+    SPMD partitioner CHECK-crashes partitioning the gather-transpose scatter
+    when its consumer is DP-resharded (ZeRO-1 moments) inside the manual
+    ``pipe`` submesh (spmd_partitioner_util.cc:495) — the pipeline used this
+    form until the embed hook moved OUTSIDE the manual region
+    (``parallel/pipeline.py``), where the cheap gather partitions fine; the
+    option remains for any future in-manual-region embedding.  With a
     TP-sharded table the contraction form is also exactly Megatron's
     vocab-parallel embedding (mask-local-vocab + all-reduce), done by GSPMD.
     """

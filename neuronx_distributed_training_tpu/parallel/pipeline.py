@@ -16,10 +16,23 @@ Re-designed rather than translated:
   wavefront, giving a full fwd-then-bwd schedule.  Per-stage activations are
   rematerialized (``jax.checkpoint``) so only stage *inputs* are saved, the
   same memory class as the reference's 1F1B-with-recompute;
-- **loss on last stage** (reference ``base.py:378-381``): the lm-head/loss
-  hook runs on every rank (SPMD — the non-last ranks compute on garbage and
-  their result is masked), but only the scalar loss crosses ranks (psum), not
-  activations;
+- **loss OUTSIDE the wavefront, balanced over ranks** (vs the reference's
+  last-stage-only loss, ``base.py:378-381``): each completed microbatch's
+  last-stage output is routed in one tick-uniform ppermute hop to rank
+  ``m % pp`` and parked there; the lm-head + CE then run ONCE, outside the
+  manual region, with the microbatch dim sharded over ``pipe``.  Total head FLOPs equal the unpipelined step (no per-rank
+  redundancy, no warmup/cooldown ticks), and the head's wall-clock is
+  ``nm/pp`` per rank instead of the reference's ``nm``-serial on the last
+  stage.  (A per-rank ``lax.cond`` gate is NOT an option: GSPMD inserts
+  collective-permutes inside the hooks whose rendezvous needs every device,
+  so a pipe-divergent branch deadlocks — verified on the 8-device mesh.)
+- **embedding also outside the wavefront**: all microbatch embeddings are
+  computed once under plain GSPMD (pipe-sharded round-robin, gather path —
+  the partitioner's gather-transpose crash only bites inside the manual
+  submesh) and routed to rank 0 tick-by-tick with a tick-uniform
+  switch+ppermute.  Net effect (tools/pp_flops_probe.py): pp=4 compiled
+  FLOPs within 2.1% of the unpipelined step at equal tokens — the residual
+  is bubble-tick stage compute, which costs no wall-clock;
 - embedding/head weights live OUTSIDE the pipelined stack and are replicated
   over ``pipe`` (they are still TP-sharded over ``model`` by GSPMD's auto
   axes) — a deliberate departure from the reference's stage-0/stage-N
@@ -37,6 +50,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from neuronx_distributed_training_tpu.parallel import sharding as shd
 
@@ -149,29 +163,83 @@ def pipeline_loss(
         )
         return loss_sum / jnp.maximum(denom_sum, 1.0) + aux_scale * aux_sum
 
-    body = functools.partial(
-        _pipeline_body,
-        embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn, pp=pp, nm=nm, vp=vp,
-        stage_aux=stage_aux, aux_scale=aux_scale,
-    )
     from jax.sharding import PartitionSpec as P
 
+    # round-robin layout shared by the embed feed and the loss parking:
+    # row g = r*slots + l <-> microbatch m = l*pp + r, dim 0 sharded over pipe
+    slots = -(-nm // pp)
+    g = np.arange(pp * slots)
+    m_of_g = (g % slots) * pp + g // slots
+    real = m_of_g < nm
+    m_idx = np.where(real, m_of_g, 0)
+    mb_perm = jax.tree_util.tree_map(lambda x: x[m_idx], microbatches)
+
+    # ---- embedding, once, outside the manual region --------------------
+    # Per-device FLOPs = (nm/pp) embeds (vs every-rank-every-tick inside the
+    # wavefront), and the hook may use the plain gather path — the SPMD
+    # partitioner's gather-transpose CHECK-crash only bites inside the manual
+    # pipe submesh.  Rank m % pp holds microbatch m's embedding; the body
+    # routes it to rank 0 at tick m with a tick-uniform switch + ppermute.
+    emb = jax.vmap(lambda m: embed_fn(params, m))(mb_perm)
+    # constrain ONLY the leading (pipe) dim: the trailing dims keep the
+    # hook's own sharding (batch over data, seq over model under SP) — a bare
+    # P("pipe") would pin them replicated and all-gather the whole global
+    # batch's embeddings across data
+    unc = P.UNCONSTRAINED
+    emb = shd.constrain(emb, P(PIPE_AXIS, *([unc] * (emb.ndim - 1))))
+
+    body = functools.partial(
+        _pipeline_body,
+        stage_fn=stage_fn, pp=pp, nm=nm, vp=vp, slots=slots,
+        stage_aux=stage_aux,
+    )
     layer_spec = P(None, PIPE_AXIS) if vp > 1 else P(PIPE_AXIS)
     fn = jax.shard_map(
         body,
         mesh=mesh,
         # manual over pipe only: params and microbatches replicated across pipe
-        # (GSPMD still shards them over data/model inside)
-        in_specs=(P(), layer_spec, P()),
-        out_specs=P(),
+        # (GSPMD still shards them over data/model inside); the embed feed and
+        # the parked outputs are pipe-sharded on dim 0
+        in_specs=(P(), layer_spec, P(), P(PIPE_AXIS)),
+        out_specs=(P(PIPE_AXIS), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
-    return fn(params, layer_params, microbatches)
+    parked, aux_total = fn(params, layer_params, microbatches, emb)
+
+    # ---- head + CE, once, outside the manual region --------------------
+    # parked row g holds microbatch m_of_g's last-stage output (same layout
+    # as the embed feed), sharded over pipe — the loss below is pipe-parallel.
+
+    def resh(x):  # [pp*slots, ...] -> [slots, pp, ...]; pp dim stays sharded
+        return jnp.swapaxes(x.reshape((pp, slots) + x.shape[1:]), 0, 1)
+
+    y_r = resh(parked)
+    mb_r = jax.tree_util.tree_map(resh, mb_perm)
+    mask_r = jnp.swapaxes(
+        jnp.asarray(real, jnp.float32).reshape(pp, slots), 0, 1
+    )
+    # remat: per scan step only (y_i, mb_i) are saved; head/CE intermediates
+    # (the [*, s, vocab]-class buffers) are recomputed in backward
+    vloss = jax.checkpoint(
+        jax.vmap(lambda y, mb: loss_fn(params, y, mb), in_axes=(0, 0))
+    )
+
+    def lbody(acc, xs):
+        y_i, mb_i, mk = xs
+        l_v, d_v = vloss(y_i, mb_i)
+        return (acc[0] + jnp.sum(l_v * mk), acc[1] + jnp.sum(d_v * mk)), None
+
+    (loss_sum, denom_sum), _ = jax.lax.scan(
+        lbody,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (y_r, mb_r, mask_r),
+    )
+    return loss_sum / jnp.maximum(denom_sum, 1.0) + aux_scale * aux_total
 
 
-def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
-                   loss_fn, pp, nm, vp, stage_aux=False, aux_scale=0.0):
+def _pipeline_body(params, local_layers, microbatches, emb, *, stage_fn,
+                   pp, nm, vp, slots, stage_aux=False):
     """Per-pipe-rank circular wavefront loop (inside shard_map, manual "pipe").
 
     Schedule: rank ``r`` at tick ``t`` works on work-index ``w = t - r`` —
@@ -180,6 +248,19 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
     the last rank come back around the cyclic ring one tick later and wait in
     ``circ_storage`` until chunk ``c+1``'s slot).  Total ticks
     ``nm*vp + pp - 1``.  With vp == 1 this is the plain GPipe wavefront.
+
+    ``emb [slots, mb, s, h]`` is this rank's round-robin share of the
+    pre-computed microbatch embeddings (microbatch ``m`` lives on rank
+    ``m % pp`` at slot ``m // pp``); the body routes slot ``t // pp`` from
+    rank ``t % pp`` to rank 0 at tick ``t`` — both the branch index and the
+    ``t < nm`` gate depend only on the tick, so every device takes the same
+    path and the collective-permute inside is safe (a RANK-dependent gate
+    would deadlock: GSPMD collectives need every device at the rendezvous).
+
+    Returns ``(parked, aux_sum)``: ``parked [slots, mb, s, h]`` holds the
+    final-chunk outputs of the microbatches this rank parks (same layout as
+    ``emb``) — the caller computes the loss over them outside the manual
+    region — and ``aux_sum`` is the psum'd MoE router aux.
     """
     rank = jax.lax.axis_index(PIPE_AXIS)
     is_first = rank == 0
@@ -193,24 +274,18 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
     else:
         local_layers = jax.tree_util.tree_map(lambda x: x[None], local_layers)
 
-    mb0 = jax.tree_util.tree_map(lambda x: x[0], microbatches)
-    x0 = embed_fn(params, mb0)  # shape/dtype template for the stream buffers
+    x0 = emb[0]  # shape/dtype template for the stream buffers
 
-    # rematerialize stage activations in backward: only stage inputs are saved
+    # rematerialize stage activations in backward: only stage inputs are
+    # saved — the stage-input O(nm * mbs*s*h) class, the same trade the
+    # reference's 1F1B-with-recompute makes.  (The embed and loss hooks left
+    # the tick loop entirely — see pipeline_loss.)
     compute = jax.checkpoint(stage_fn)
-    # the embed and loss hooks run EVERY tick; un-rematerialized, their
-    # residuals are retained for all nm+pp-1 ticks — the loss hook's
-    # [mbs, s, vocab] logits dominate the high-water (measured 4.5x the
-    # unpipelined step at pp=4/nm=16, tools/pp_memory_probe.py).
-    # remat brings the schedule back to the stage-input O(nm * mbs*s*h)
-    # class, the same trade the reference's 1F1B-with-recompute makes.
-    embed = jax.checkpoint(embed_fn)
-    compute_loss = jax.checkpoint(loss_fn)
 
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
 
     def tick(carry, t):
-        recv, circ, loss_acc, denom_acc, aux_acc = carry
+        recv, circ, park, aux_acc = carry
 
         if vp > 1:
             # rank 0: recv holds last-rank output from tick t-1 (work index
@@ -231,10 +306,25 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
             lambda x: jax.lax.dynamic_index_in_dim(x, m, 0, keepdims=False),
             microbatches,
         )
-        fresh = embed(params, mb)
+        # rank 0 consumes microbatch t's embedding at tick t (< nm): fetch it
+        # from its round-robin owner.  Branch index and gate are tick-only —
+        # uniform across every device (see docstring).
+        e_t = jax.lax.dynamic_index_in_dim(
+            emb, jnp.clip(t // pp, 0, slots - 1), 0, keepdims=False
+        )
+        fresh = jax.lax.cond(
+            t < nm,
+            lambda: jax.lax.switch(
+                jnp.remainder(t, pp),
+                [functools.partial(
+                    jax.lax.ppermute, e_t, PIPE_AXIS, [(o, 0)]
+                ) for o in range(pp)],
+            ),
+            lambda: jnp.zeros(x0.shape, x0.dtype),
+        )
         if vp > 1:
-            parked = jax.lax.dynamic_index_in_dim(circ, m, 0, keepdims=False)
-            first_in = jnp.where(c == 0, fresh, parked)
+            parked_in = jax.lax.dynamic_index_in_dim(circ, m, 0, keepdims=False)
+            first_in = jnp.where(c == 0, fresh, parked_in)
         else:
             first_in = fresh
         x = jnp.where(is_first, first_in, recv)
@@ -251,28 +341,46 @@ def _pipeline_body(params, local_layers, microbatches, *, embed_fn, stage_fn,
         work_valid = jnp.logical_and(w >= 0, w < nm * vp)
         aux_acc = aux_acc + jnp.where(work_valid, s_aux, 0.0)
 
-        loss, denom = compute_loss(params, y, mb)
-        valid = jnp.logical_and(
-            jnp.logical_and(is_last, c == vp - 1), jnp.logical_and(w >= 0, w < nm * vp)
+        # microbatch m_done finishes its LAST chunk on the last rank this
+        # tick; route it to its parking rank m_done % pp in ONE hop (the
+        # same tick-uniform switch + ppermute as the embed feed above — the
+        # destination depends only on the tick, so every device takes the
+        # same branch).  The loss is computed over the parked outputs
+        # outside the manual region.
+        w_done = t - (pp - 1)
+        done_valid = jnp.logical_and(
+            w_done >= nm * (vp - 1), w_done < nm * vp
         )
-        loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
-        denom_acc = denom_acc + jnp.where(valid, denom, 0.0)
+        m_done = jnp.clip(jnp.remainder(w_done, nm), 0, nm - 1)
+        y_b = jax.lax.cond(
+            done_valid,
+            lambda: jax.lax.switch(
+                jnp.remainder(m_done, pp),
+                [functools.partial(
+                    jax.lax.ppermute, y, PIPE_AXIS, [(pp - 1, o)]
+                ) for o in range(pp)],
+            ),
+            lambda: jnp.zeros(x0.shape, x0.dtype),
+        )
+        mine = jnp.logical_and(done_valid, jnp.remainder(m_done, pp) == rank)
+        p_slot = m_done // pp
+        cur = jax.lax.dynamic_index_in_dim(park, p_slot, 0, keepdims=False)
+        park = jax.lax.dynamic_update_index_in_dim(
+            park, jnp.where(mine, y_b, cur), p_slot, 0
+        )
 
         recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
-        return (recv, circ, loss_acc, denom_acc, aux_acc), None
+        return (recv, circ, park, aux_acc), None
 
     zeros = jnp.zeros_like(x0)
     circ0 = (
         jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1 else jnp.zeros((1, 1), x0.dtype)
     )
-    (_, _, loss_acc, denom_acc, aux_acc), _ = jax.lax.scan(
+    park0 = jnp.zeros((slots,) + x0.shape, x0.dtype)
+    (_, _, park, aux_acc), _ = jax.lax.scan(
         tick,
-        (zeros, circ0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-         jnp.zeros((), jnp.float32)),
+        (zeros, circ0, park0, jnp.zeros((), jnp.float32)),
         jnp.arange(nm * vp + pp - 1),
     )
-    # only the last rank's accumulators are real; psum broadcasts the scalars
-    loss_total = jax.lax.psum(loss_acc, PIPE_AXIS)
-    denom_total = jax.lax.psum(denom_acc, PIPE_AXIS)
     aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
-    return loss_total / jnp.maximum(denom_total, 1.0) + aux_scale * aux_total
+    return park, aux_total

@@ -1,0 +1,105 @@
+"""Pipeline redundant-FLOPs probe (VERDICT r3 item 2).
+
+Question: after hoisting the lm-head/loss out of the wavefront (round-robin
+parked outputs, loss once outside the manual region — ``parallel/pipeline.py``)
+how close are the pipelined step's compiled FLOPs to the unpipelined step at
+equal tokens?  Before the hoist every pipe rank computed head+CE every tick:
+~``pp * (nm+pp-1)/nm``x the head FLOPs of the unpipelined step (the reference
+instead computes loss on the last stage only, ``base.py:378-381``).
+
+Method: compile the REAL jitted train step on the 8-device virtual CPU mesh
+with a vocab-heavy tiny model (vocab 8192 >> hidden 128, so the head term
+dominates like Llama-3's 128k-vocab head) at pp=4/dp=2 and pp=1/dp=8, equal
+global batch, and compare XLA ``cost_analysis()['flops']``.  The only
+remaining expected gap is bubble-tick stage compute ((pp-1)/(nm+pp-1) of
+stage FLOPs, inherent to the SPMD wavefront — the reference's MPMD ranks
+idle instead, same wall-clock); the embed is hoisted+sharded too.
+
+Measured 2026-07-30 (this probe, bench_results/pp_flops_probe.json):
+ratio pp4/pp1 = 1.0205 — within 2.1% of unpipelined at equal tokens.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      PYTHONPATH=/root/repo:$PYTHONPATH python tools/pp_flops_probe.py
+"""
+
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from neuronx_distributed_training_tpu.config.loader import load_config  # noqa: E402
+from neuronx_distributed_training_tpu.trainer.loop import Trainer  # noqa: E402
+
+HIDDEN = 128
+LAYERS = 8
+SEQ = 256
+VOCAB = 8192
+GBS = int(os.environ.get("PROBE_GBS", 32))
+
+
+def cfg_for(pp: int) -> dict:
+    return {
+        "name": f"flopsprobe_pp{pp}",
+        "model_source": "hf",
+        "seed": 0,
+        "trainer": {"max_steps": 1, "log_every_n_steps": 1},
+        "distributed_strategy": {
+            "pipeline_model_parallel_size": pp,
+            "tensor_model_parallel_size": 1,
+        },
+        "data": {"global_batch_size": GBS, "micro_batch_size": 1,
+                 "seq_length": SEQ, "synthetic": True},
+        "model": {
+            "vocab_size": VOCAB,
+            "hidden_size": HIDDEN,
+            "intermediate_size": 2 * HIDDEN,
+            "num_layers": LAYERS,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 4,
+            "max_position_embeddings": SEQ,
+            "activations_checkpoint_granularity": "full",
+            "optim": {"name": "adamw_fp32OptState", "lr": 1e-4,
+                      "sched": {"name": "constant"}},
+        },
+        "precision": {"type": "fp32"},
+    }
+
+
+def measure(pp: int) -> dict:
+    t = Trainer.from_config(load_config(cfg_for(pp)), enable_checkpointing=False)
+    batch = next(t.data_module.sharded_batches(t.mesh))
+    compiled = t.train_step.lower(
+        t.params, t.opt_state, batch, jax.random.PRNGKey(0)
+    ).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    out = {"pp": pp, "flops": float(ca.get("flops", -1.0))}
+    del t
+    return out
+
+
+def main() -> None:
+    res = {pp: measure(pp) for pp in (1, 4)}
+    for r in res.values():
+        print(json.dumps(r))
+    nm = GBS // (8 // 4)  # pp=4 -> dp=2, mbs=1
+    # head fwd FLOPs at equal tokens (one pass over the global batch)
+    head = 2.0 * GBS * SEQ * HIDDEN * VOCAB
+    summary = {
+        "nm_pp4": nm,
+        "flops_ratio_pp4_vs_pp1": round(res[4]["flops"] / res[1]["flops"], 4),
+        "head_fraction_of_pp1": round(head / res[1]["flops"], 4),
+        "old_design_head_redundancy_x": round(4 * (nm + 4 - 1) / nm, 2),
+        "pp4_gflops": round(res[4]["flops"] / 1e9, 2),
+        "pp1_gflops": round(res[1]["flops"] / 1e9, 2),
+    }
+    print(json.dumps(summary))
+    with open("bench_results/pp_flops_probe.json", "w") as f:
+        json.dump({**{f"pp{k}": v for k, v in res.items()},
+                   "summary": summary}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
