@@ -147,10 +147,14 @@ def pipeline_loss(
     if pp == 1:
         if vp > 1:
             layer_params = from_interleaved(layer_params)
+        # same remat class as the pp>1 wavefront: per microbatch only the
+        # stage input is saved (without this, the scan retains every layer's
+        # activations for all nm microbatches)
+        stage_ck = jax.checkpoint(stage_fn)
 
         def body(acc, mb):
             x = embed_fn(params, mb)
-            out = stage_fn(layer_params, x, {**mb, "_chunk": jnp.zeros((), jnp.int32)})
+            out = stage_ck(layer_params, x, {**mb, "_chunk": jnp.zeros((), jnp.int32)})
             x, s_aux = out if stage_aux else (out, jnp.zeros((), jnp.float32))
             loss, denom = loss_fn(params, x, mb)
             return (acc[0] + loss, acc[1] + denom, acc[2] + s_aux), None
@@ -197,15 +201,17 @@ def pipeline_loss(
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        # manual over pipe only: params and microbatches replicated across pipe
-        # (GSPMD still shards them over data/model inside); the embed feed and
-        # the parked outputs are pipe-sharded on dim 0
-        in_specs=(P(), layer_spec, P(), P(PIPE_AXIS)),
+        # manual over pipe only: layers sharded on their pipe dim,
+        # microbatches replicated across pipe (GSPMD still shards them over
+        # data/model inside); the embed feed and the parked outputs are
+        # pipe-sharded on dim 0.  (params themselves are not an operand —
+        # the embed and loss hooks, the only consumers, run outside.)
+        in_specs=(layer_spec, P(), P(PIPE_AXIS)),
         out_specs=(P(PIPE_AXIS), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
-    parked, aux_total = fn(params, layer_params, microbatches, emb)
+    parked, aux_total = fn(layer_params, microbatches, emb)
 
     # ---- head + CE, once, outside the manual region --------------------
     # parked row g holds microbatch m_of_g's last-stage output (same layout
@@ -238,7 +244,7 @@ def pipeline_loss(
     return loss_sum / jnp.maximum(denom_sum, 1.0) + aux_scale * aux_total
 
 
-def _pipeline_body(params, local_layers, microbatches, emb, *, stage_fn,
+def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
                    pp, nm, vp, slots, stage_aux=False):
     """Per-pipe-rank circular wavefront loop (inside shard_map, manual "pipe").
 
@@ -279,8 +285,20 @@ def _pipeline_body(params, local_layers, microbatches, emb, *, stage_fn,
     # rematerialize stage activations in backward: only stage inputs are
     # saved — the stage-input O(nm * mbs*s*h) class, the same trade the
     # reference's 1F1B-with-recompute makes.  (The embed and loss hooks left
-    # the tick loop entirely — see pipeline_loss.)
-    compute = jax.checkpoint(stage_fn)
+    # the tick loop entirely — see pipeline_loss.)  The per-chunk layer
+    # slicing happens INSIDE the checkpointed region: sliced with a traced
+    # chunk index OUTSIDE it, the slice becomes a per-tick residual the scan
+    # stacks — a params-sized save every tick (measured 0.5 GiB x L x nm at
+    # 70B shape, tools/pp_memory_flagship.py) instead of one loop-invariant
+    # reference to the param buffer.
+    def _stage_sliced(ll, c, x, mb):
+        lp_c = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            ll,
+        )
+        return stage_fn(lp_c, x, mb)
+
+    compute = jax.checkpoint(_stage_sliced)
 
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -329,11 +347,7 @@ def _pipeline_body(params, local_layers, microbatches, emb, *, stage_fn,
             first_in = fresh
         x = jnp.where(is_first, first_in, recv)
 
-        lp_c = jax.tree_util.tree_map(
-            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
-            local_layers,
-        )
-        out = compute(lp_c, x, {**mb, "_chunk": c})
+        out = compute(local_layers, c, x, {**mb, "_chunk": c})
         y, s_aux = out if stage_aux else (out, jnp.zeros((), jnp.float32))
         # every rank+chunk contributes its local layers' aux once per valid
         # work index (the MoE router-loss carry: psum over pipe at the end

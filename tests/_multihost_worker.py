@@ -6,12 +6,23 @@ prioritizes) and 4 virtual CPU devices, so the pair forms one 8-device
 global mesh — the same topology class as two TPU hosts on DCN (SURVEY §4
 plan item (b); reference rendezvous examples/train_setup.sh:8-67).
 
-Exercises, across REAL processes: jax.distributed rendezvous via
-utils.launch.initialize_distributed, a global mesh spanning both processes,
-per-process device_put slices assembled with
-jax.make_array_from_single_device_arrays (data/loader.shard_batch), and two
-jitted train steps whose gradient all-reduces ride the inter-process
-channel.  Prints LOSS/PARAMSUM lines the parent compares across ranks.
+Exercises, across REAL processes:
+
+- phase 1: jax.distributed rendezvous via utils.launch.initialize_distributed,
+  a global dp=4 x tp=2 mesh spanning both processes, per-process device_put
+  slices assembled with jax.make_array_from_single_device_arrays
+  (data/loader.shard_batch), and two jitted train steps whose gradient
+  all-reduces ride the inter-process channel;
+- phase 2: the SAME workload on a mesh laid out by ``mesh.dcn_split`` with
+  each process standing in for one DCN slice — the ``data`` axis's outer
+  factor IS the process boundary, so gradient all-reduce crosses the
+  DCN-class link while every ``model`` (TP) group stays inside one process
+  (the multi-slice recipe build_mesh applies on real multi-slice TPU;
+  reference multi-node path: examples/train_setup.sh:8-67).
+
+Prints LOSS/PARAMSUM (phase 1) and LOSS2/PARAMSUM2 (phase 2) lines the
+parent compares across ranks, plus DCN_SPAN_OK asserting the data-axis
+groups really straddle the processes.
 """
 
 import os
@@ -24,19 +35,12 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
+def _train_two_steps(mesh, cfg, policy, seed=11):
+    """Init + two jitted train steps on ``mesh``; returns (loss, param_sum)."""
+    import functools
+
     import jax.numpy as jnp
-
-    from neuronx_distributed_training_tpu.utils.launch import (
-        detect_cluster,
-        initialize_distributed,
-    )
-
-    spec = detect_cluster()
-    assert spec.managed_by == "nxdt-env", spec
-    initialize_distributed(spec)
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 8, len(jax.devices())
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from neuronx_distributed_training_tpu.data import SyntheticDataModule
     from neuronx_distributed_training_tpu.models import llama
@@ -46,31 +50,14 @@ def main() -> None:
         opt_state_specs,
     )
     from neuronx_distributed_training_tpu.parallel import sharding as shd
-    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
     from neuronx_distributed_training_tpu.trainer.step import (
         jit_train_step,
         make_train_step,
     )
-    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
-
-    cfg = llama.LlamaConfig(
-        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
-        num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
-        activations_checkpoint_granularity=None,
-    )
-    policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
-                         softmax_dtype=jnp.float32)
-    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))  # dp=4 x tp=2
 
     with mesh, shd.use_mesh(mesh):
         pspecs = llama.param_specs(cfg)
-        import functools
-
-        from jax.sharding import NamedSharding
-
         ns = functools.partial(NamedSharding, mesh)
-        from jax.sharding import PartitionSpec as P
-
         p_sh = jax.tree_util.tree_map(
             ns, pspecs, is_leaf=lambda x: isinstance(x, P))
         params = jax.jit(
@@ -93,7 +80,7 @@ def main() -> None:
         jstep = jit_train_step(step_fn, mesh, pspecs, ospecs)
 
         dm = SyntheticDataModule(vocab_size=128, seq_len=32,
-                                 global_batch_size=8, seed=11)
+                                 global_batch_size=8, seed=seed)
         it = dm.sharded_batches(mesh)
         loss = None
         for i, batch in enumerate(it):
@@ -104,8 +91,77 @@ def main() -> None:
             loss = float(metrics["loss"])
         psum = float(sum(jnp.sum(x.astype(jnp.float64))
                          for x in jax.tree_util.tree_leaves(params)))
+    return loss, psum
+
+
+def main() -> None:
+    import jax.numpy as jnp  # noqa: F401  (imported for helper parity)
+
+    from neuronx_distributed_training_tpu.utils.launch import (
+        detect_cluster,
+        initialize_distributed,
+    )
+
+    spec = detect_cluster()
+    assert spec.managed_by == "nxdt-env", spec
+    initialize_distributed(spec)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from neuronx_distributed_training_tpu.models import llama
+    from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_attention_heads=4, num_kv_heads=2, max_position_embeddings=32,
+        activations_checkpoint_granularity=None,
+    )
+    policy = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                         softmax_dtype=jnp.float32)
+
+    # ---- phase 1: flat global mesh (dp=4 x tp=2) -------------------------
+    mesh = build_mesh(MeshConfig(tensor_model_parallel_size=2))
+    loss, psum = _train_two_steps(mesh, cfg, policy)
     print(f"LOSS {loss:.8f}")
     print(f"PARAMSUM {psum:.6f}")
+
+    # ---- phase 2: dcn_split layout — data axis spans the processes -------
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from neuronx_distributed_training_tpu.parallel.mesh import AXES, dcn_split
+
+    mesh_cfg = MeshConfig(tensor_model_parallel_size=2)
+    shape = mesh_cfg.shape(8)
+    dims = tuple(shape[a] for a in AXES)
+    split = dcn_split(dims, num_slices=2)
+    assert split is not None, f"dcn_split refused {dims}"
+    dcn_shape, ici_shape = split
+    # data carries the slice factor (the least-frequent collective), every
+    # other axis stays intra-slice — the build_mesh multi-slice invariant
+    assert dcn_shape[AXES.index("data")] == 2 and sum(dcn_shape) == len(dims) + 1
+    # realize the layout with process == slice: jax.devices() orders process
+    # 0's devices first, so [slice, ici_data, model] -> AXES shape puts the
+    # slice factor OUTERMOST on the data axis
+    devs = np.array(jax.devices()).reshape(
+        2, ici_shape[AXES.index("data")], dims[AXES.index("model")]
+    )
+    dev_array = devs.reshape(dims)
+    mesh2 = Mesh(dev_array, AXES)
+    # the point of the layout: data-axis groups straddle the process
+    # boundary (gradient all-reduce crosses DCN)...
+    data_col = dev_array[0, :, 0, 0, 0]
+    assert {d.process_index for d in data_col} == {0, 1}, data_col
+    # ...while every TP (model) group stays inside ONE process
+    for di in range(dims[AXES.index("data")]):
+        tp_group = dev_array[0, di, 0, 0, :]
+        assert len({d.process_index for d in tp_group}) == 1, tp_group
+    print("DCN_SPAN_OK")
+
+    loss2, psum2 = _train_two_steps(mesh2, cfg, policy)
+    print(f"LOSS2 {loss2:.8f}")
+    print(f"PARAMSUM2 {psum2:.6f}")
     print("MULTIHOST_OK", jax.process_index())
 
 

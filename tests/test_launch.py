@@ -98,7 +98,15 @@ def test_two_process_rendezvous_and_fit():
     loopback) through utils.launch.initialize_distributed, a global 8-device
     mesh spanning both processes, and two jitted train steps whose grad
     all-reduces cross the inter-process channel.  Both ranks must see the
-    same loss and final param sum (SPMD determinism)."""
+    same loss and final param sum (SPMD determinism).
+
+    Phase 2 (in the same workers): the identical workload on a mesh laid out
+    by ``mesh.dcn_split`` with process == DCN slice — the ``data`` axis's
+    outer factor spans the two processes (gradient all-reduce crosses the
+    DCN-class link) while every TP group stays inside one process.  Ranks
+    must agree exactly, and the result must match the flat-mesh phase to
+    reduction-order tolerance (same global math, different placement) —
+    reference multi-node path ``examples/train_setup.sh:8-67``."""
     import socket
     import subprocess
     import sys
@@ -136,3 +144,16 @@ def test_two_process_rendezvous_and_fit():
 
     assert grab(outs[0], "LOSS") == grab(outs[1], "LOSS")
     assert grab(outs[0], "PARAMSUM") == grab(outs[1], "PARAMSUM")
+    # phase 2: dcn_split mesh — data axis spanning the processes
+    for out in outs:
+        assert "DCN_SPAN_OK" in out, out[-2000:]
+    assert grab(outs[0], "LOSS2") == grab(outs[1], "LOSS2")
+    assert grab(outs[0], "PARAMSUM2") == grab(outs[1], "PARAMSUM2")
+    # same global math on a permuted placement: agreement to
+    # reduction-order tolerance pins the cross-process grad all-reduce
+    l1 = float(grab(outs[0], "LOSS ").split()[1])
+    l2 = float(grab(outs[0], "LOSS2").split()[1])
+    assert abs(l1 - l2) < 1e-5, (l1, l2)
+    s1 = float(grab(outs[0], "PARAMSUM ").split()[1])
+    s2 = float(grab(outs[0], "PARAMSUM2").split()[1])
+    assert abs(s1 - s2) < 1e-3, (s1, s2)

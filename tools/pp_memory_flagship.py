@@ -1,0 +1,164 @@
+"""Flagship-shape pipeline memory probe (VERDICT r3 item 3).
+
+Round 3 measured the GPipe-wavefront stage-input retention at TOY shape
+(h=256, s=512) and extrapolated the 70B-class delta; this probe lowers the
+REAL jitted train step (fwd+bwd+AdamW) compile-only at flagship shape —
+pp=8 x vp=2, nm=32, mbs=1, s=8192, h=8192, L=80 (Llama-3-70B geometry,
+examples/conf/hf_llama3_70B_config.yaml) — on the 8-device virtual CPU mesh
+and reads XLA's own ``memory_analysis()``.
+
+Nothing is allocated: params/opt-state/batch are ``jax.eval_shape`` abstract
+values, so the 70B argument tensors never materialize; buffer assignment
+(the same XLA pass TPU uses) still reports the temp high-water.
+
+The real 70B config runs tp=32 with SP, which shards the [1, s, h] stage
+inputs 32x; on the pp-only virtual mesh each rank carries the full 128 MiB
+input, so analytic expectations below scale by exactly that factor — the
+GPipe-vs-1F1B retention RATIO is shape-preserving.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      PYTHONPATH=/root/repo:$PYTHONPATH python tools/pp_memory_flagship.py
+"""
+
+import functools
+import json
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from neuronx_distributed_training_tpu.models import llama  # noqa: E402
+from neuronx_distributed_training_tpu.optim.adamw import (  # noqa: E402
+    AdamWConfig,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.optim.lr import (  # noqa: E402
+    linear_annealing_with_warmup,
+)
+from neuronx_distributed_training_tpu.parallel import sharding as shd  # noqa: E402
+from neuronx_distributed_training_tpu.parallel.mesh import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+)
+from neuronx_distributed_training_tpu.parallel.pipeline import (  # noqa: E402
+    pipeline_loss,
+    to_interleaved,
+)
+from neuronx_distributed_training_tpu.trainer.step import (  # noqa: E402
+    jit_train_step,
+    make_train_step,
+    microbatch_split,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy  # noqa: E402
+
+PP = int(os.environ.get("PROBE_PP", 8))
+VP = int(os.environ.get("PROBE_VP", 2))
+NM = int(os.environ.get("PROBE_NM", 32))
+MBS = 1
+SEQ = int(os.environ.get("PROBE_SEQ", 8192))
+HID = int(os.environ.get("PROBE_HID", 8192))
+LAYERS = int(os.environ.get("PROBE_LAYERS", 80))
+
+
+def main() -> None:
+    cfg = llama.LlamaConfig(
+        vocab_size=128256,
+        hidden_size=HID,
+        intermediate_size=28672 * HID // 8192,
+        num_layers=LAYERS,
+        num_attention_heads=64,
+        num_kv_heads=8,
+        max_position_embeddings=SEQ,
+        attention_impl="flash",
+        # the 70B config runs chunked CE (fusions.chunked_ce class) to keep
+        # the [*, s, 128k] logits out of HBM; 8 chunks matches its scale
+        vocab_chunks=8,
+        tie_word_embeddings=True,
+        activations_checkpoint_granularity="full",
+    )
+    policy = DtypePolicy.from_precision_config("mixed_precision")
+    mesh = build_mesh(
+        MeshConfig(pipeline_model_parallel_size=PP,
+                   virtual_pipeline_model_parallel_size=VP),
+        devices=jax.devices()[:8],
+    )
+
+    embed_fn, stage_fn, stage_loss = llama.pipeline_hooks(cfg, policy)
+
+    def loss_fn(p, batch, step_key):
+        mbs = microbatch_split(batch, NM)
+        return pipeline_loss(
+            p, p["layers"], mbs, embed_fn=embed_fn, stage_fn=stage_fn,
+            loss_fn=stage_loss, mesh=mesh, num_microbatches=NM,
+            virtual_pipeline_size=VP,
+        ), {}
+
+    def init_fn(key):
+        p = llama.init_params(key, cfg, policy)
+        return {**p, "layers": to_interleaved(p["layers"], PP, VP)}
+
+    pspecs = llama.param_specs(cfg, pipeline=True)
+    pspecs["layers"] = jax.tree_util.tree_map(
+        lambda s: P(None, s[0], None, *tuple(s)[1:]), pspecs["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+    with mesh, shd.use_mesh(mesh):
+        t0 = time.perf_counter()
+        params = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        opt_state = jax.eval_shape(
+            functools.partial(init_opt_state, policy=policy), params
+        )
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy)
+        step = make_train_step(
+            loss_fn, AdamWConfig(grad_clip_norm=1.0),
+            linear_annealing_with_warmup(1e-4, 10, 100), policy,
+            num_microbatches=1,
+        )
+        jstep = jit_train_step(step, mesh, pspecs, ospecs,
+                               batch_spec=P(("data", "expert")))
+        batch = {
+            "input_ids": jax.ShapeDtypeStruct((NM * MBS, SEQ), jnp.int32),
+            "labels": jax.ShapeDtypeStruct((NM * MBS, SEQ), jnp.int32),
+        }
+        lowered = jstep.lower(params, opt_state, batch, jax.random.PRNGKey(1))
+        t_lower = time.perf_counter() - t0
+        compiled = lowered.compile()
+        t_compile = time.perf_counter() - t0 - t_lower
+        ma = compiled.memory_analysis()
+
+    gib = 2.0 ** 30
+    stage_input = MBS * SEQ * HID * 2  # bf16 [mbs, s, h]
+    ticks = NM * VP + PP - 1
+    out = {
+        "shape": {"pp": PP, "vp": VP, "nm": NM, "mbs": MBS, "seq": SEQ,
+                  "hidden": HID, "layers": LAYERS, "vocab": 128256},
+        "lower_s": round(t_lower, 1),
+        "compile_s": round(t_compile, 1),
+        "temp_gib": round(ma.temp_size_in_bytes / gib, 3),
+        "argument_gib": round(ma.argument_size_in_bytes / gib, 3),
+        "output_gib": round(ma.output_size_in_bytes / gib, 3),
+        "analytic": {
+            "stage_input_mib": round(stage_input / 2 ** 20, 1),
+            "gpipe_ticks": ticks,
+            "gpipe_retention_gib": round(ticks * stage_input / gib, 3),
+            "onef1b_retention_gib": round(PP * stage_input / gib, 3),
+            "parked_plus_embed_feed_gib": round(
+                2 * (-(-NM // PP)) * stage_input / gib, 3
+            ),
+            "note": "real 70B runs tp=32+SP: divide activation terms by 32",
+        },
+    }
+    print(json.dumps(out))
+    with open("bench_results/pp_memory_flagship.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
