@@ -623,28 +623,46 @@ class Trainer:
                 # batches restart AT the cursor (not at cursor rounded to a
                 # bs multiple): a resume with a different global_batch_size
                 # must still recompute every remaining sample
+                import time as _time
+
+                from neuronx_distributed_training_tpu.data.loader import (
+                    PrefetchIterator,
+                )
+
                 starts = list(range(done, n, bs))
                 total = len(starts)
                 log_every = max(1, total // 20)
                 spill_every = max(1, total // 10)
-                batches = (
-                    {k: v[i:min(i + bs, n)] for k, v in dm.arrays.items()}
-                    for i in starts
+                # same host/device overlap as the fit loop: row slicing
+                # happens on the prefetch thread, not between dispatches
+                batches = PrefetchIterator(
+                    ({k: v[i:min(i + bs, n)] for k, v in dm.arrays.items()}
+                     for i in starts)
                 )
-                for j, part in enumerate(_ref_iter(ref_params, batches,
-                                                   forward_logits)):
-                    if not cols:
-                        cols = {k: np.empty((n,), v.dtype) for k, v in part.items()}
-                    i = starts[j]
-                    for k, v in part.items():
-                        cols[k][i:i + len(v)] = v
-                    done = min(i + bs, n)
-                    if (j + 1) % log_every == 0 or done >= n:
-                        logger.info("%s reference-logp pass: %d/%d samples",
-                                    tag, done, n)
-                    if sidecar is not None and ((j + 1) % spill_every == 0
-                                                or done >= n):
-                        _sidecar_store(sidecar, done, cols)
+                start_done, t0 = done, _time.perf_counter()
+                try:
+                    for j, part in enumerate(_ref_iter(ref_params, batches,
+                                                       forward_logits)):
+                        if not cols:
+                            cols = {k: np.empty((n,), v.dtype)
+                                    for k, v in part.items()}
+                        i = starts[j]
+                        for k, v in part.items():
+                            cols[k][i:i + len(v)] = v
+                        done = min(i + bs, n)
+                        if (j + 1) % log_every == 0 or done >= n:
+                            rate = (done - start_done) / max(
+                                _time.perf_counter() - t0, 1e-9)
+                            logger.info(
+                                "%s reference-logp pass: %d/%d samples "
+                                "(%.0f samples/s, ETA %.0fs)",
+                                tag, done, n, rate, (n - done) / max(rate, 1e-9),
+                            )
+                        if sidecar is not None and ((j + 1) % spill_every == 0
+                                                    or done >= n):
+                            _sidecar_store(sidecar, done, cols)
+                finally:
+                    batches.close()
                 dm.attach_reference_logprobs(cols)
 
             def pre_fit(trainer: "Trainer") -> None:

@@ -566,6 +566,28 @@ class TestStreamedReferencePass:
             np.testing.assert_allclose(dm2.arrays[k], full[k], rtol=1e-5,
                                        err_msg=f"{k} after mid-pass resume")
 
+    def test_pass_logs_progress_and_eta(self, tmp_path, devices8, caplog):
+        """The pass is not a silent multi-hour phase at scale: progress lines
+        carry throughput + ETA (VERDICT r3 item 6)."""
+        import logging
+
+        from neuronx_distributed_training_tpu.data.modules import DPODataModule
+
+        cfg = tiny_cfg(tmp_path, max_steps=1)
+        cfg["model_alignment_strategy"] = "dpo"
+        dm = DPODataModule(self._records(24), self.CharTok(), seq_length=32,
+                           global_batch_size=8)
+        t = Trainer.from_config(cfg, data_module=dm, enable_checkpointing=False)
+        with caplog.at_level(
+                logging.INFO,
+                logger="neuronx_distributed_training_tpu.trainer.loop"):
+            t.pre_fit(t)
+        lines = [r.message for r in caplog.records
+                 if "reference-logp pass" in r.message]
+        assert lines, caplog.records
+        assert any("ETA" in l and "samples/s" in l for l in lines), lines
+        assert any("24/24" in l for l in lines), lines
+
     def test_kto_val_module_columns(self, tmp_path, devices8):
         from neuronx_distributed_training_tpu.data.modules import KTODataModule
 

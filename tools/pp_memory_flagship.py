@@ -156,7 +156,17 @@ def main() -> None:
         },
     }
     print(json.dumps(out))
-    with open("bench_results/pp_memory_flagship.json", "w") as f:
+    # canonical artifact only for the canonical shape: PROBE_* override runs
+    # write a suffixed file instead of clobbering the headline numbers
+    default = (PP, VP, NM, SEQ, HID, LAYERS) == (8, 2, 32, 8192, 8192, 80)
+    suffix = "" if default else (
+        f"_pp{PP}vp{VP}nm{NM}s{SEQ}h{HID}L{LAYERS}"
+    )
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "bench_results",
+        f"pp_memory_flagship{suffix}.json",
+    )
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
 
 
