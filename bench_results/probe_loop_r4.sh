@@ -19,7 +19,14 @@ print('PROBE_OK', d[0].platform, d[0].device_kind, round(time.time()-t0,1))
     echo "BACKEND HEALTHY at $(date -u +%H:%M:%S) - running bench" >> "$LOG"
     timeout 5400 env PYTHONPATH=/root/repo:/root/.axon_site \
       python bench.py >> "$BLOG" 2>&1
-    echo "bench rc=$? done at $(date -u +%H:%M:%S)" >> "$LOG"
+    rc=$?
+    echo "bench rc=$rc done at $(date -u +%H:%M:%S)" >> "$LOG"
+    if [ "$rc" = "0" ]; then
+      # r3_notes follow-up 1 (small, never-over-allocate): EMA donation repro
+      timeout 1200 env PYTHONPATH=/root/repo:/root/.axon_site \
+        python tools/ema_donation_probe.py >> "$BLOG" 2>&1
+      echo "ema_donation_probe rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+    fi
     exit 0
   fi
   sleep 240

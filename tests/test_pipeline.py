@@ -54,6 +54,29 @@ def pipe_loss(params, mbs, mesh):
     )
 
 
+
+def shard_inputs(mesh, params, mbs):
+    """device_put params (pipeline specs) + microbatches onto ``mesh``."""
+    specs = llama.param_specs(CFG, pipeline=True)
+    ns = functools.partial(NamedSharding, mesh)
+    sh_params = jax.device_put(
+        params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    sh_mbs = jax.device_put(mbs, ns(P(None, ("data", "expert"))))
+    return sh_params, sh_mbs
+
+
+def assert_grads_close(grads, ref_grads, paths, tag=""):
+    for path in paths:
+        g, rg = grads, ref_grads
+        for k in path:
+            g, rg = g[k], rg[k]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {path} {tag}",
+        )
+
+
 class TestPipelineParity:
     def test_stage_layer_slice(self):
         assert stage_layer_slice(8, 2) == 4
@@ -69,30 +92,58 @@ class TestPipelineParity:
 
         mesh = build_mesh(MeshConfig(
             pipeline_model_parallel_size=pp, tensor_model_parallel_size=tp))
-        specs = llama.param_specs(CFG, pipeline=True)
-        ns = functools.partial(NamedSharding, mesh)
-        sh_params = jax.device_put(
-            params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
-        )
-        sh_mbs = jax.device_put(mbs, ns(P(None, ("data", "expert"))))
+        sh_params, sh_mbs = shard_inputs(mesh, params, mbs)
         with mesh, shd.use_mesh(mesh):
             loss, grads = jax.jit(
                 jax.value_and_grad(lambda p, m: pipe_loss(p, m, mesh), argnums=0)
             )(sh_params, sh_mbs)
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
-        for path in (
+        assert_grads_close(grads, ref_grads, (
             ("embed", "embedding"),
             ("final_norm", "scale"),
             ("layers", "mlp", "down", "w"),
             ("layers", "attn", "qkv", "w"),
-        ):
-            g, rg = grads, ref_grads
-            for k in path:
-                g, rg = g[k], rg[k]
-            np.testing.assert_allclose(
-                np.asarray(g), np.asarray(rg), rtol=5e-4, atol=1e-5,
-                err_msg=f"grad mismatch at {path}",
-            )
+        ))
+
+    def test_nm_not_divisible_by_pp(self, devices8):
+        """nm % pp != 0: the round-robin parking/embed layout pads to
+        ceil(nm/pp) slots per rank; padded rows must not leak into loss or
+        grads (r4 design, reviewed-but-untested path)."""
+        params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1), nm=6)  # pp=4 -> slots=2, 2 pads
+
+        ref, ref_grads = jax.value_and_grad(ref_loss)(params, mbs)
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=4))
+        sh_params, sh_mbs = shard_inputs(mesh, params, mbs)
+        with mesh, shd.use_mesh(mesh):
+            loss, grads = jax.jit(
+                jax.value_and_grad(lambda p, m: pipe_loss(p, m, mesh), argnums=0)
+            )(sh_params, sh_mbs)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+        assert_grads_close(
+            grads, ref_grads,
+            (("embed", "embedding"), ("layers", "mlp", "down", "w")),
+            tag="(nm=6, pp=4)",
+        )
+
+    def test_forward_collective_budget(self, devices8):
+        """Regression guard on the wavefront's comm schedule: the FORWARD
+        pipeline at pp=4/tp=1 compiles exactly 2*pp+1 collective-permutes
+        (the ring hop, plus one instruction per switch branch for the
+        tick-uniform embed route and parked route) and no all-gathers — a
+        divergent-cond or reshard regression inside the body would change
+        these counts."""
+        from neuronx_distributed_training_tpu.utils.debug import collective_counts
+
+        params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1))
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=4))
+        sh_params, sh_mbs = shard_inputs(mesh, params, mbs)
+        with mesh, shd.use_mesh(mesh):
+            f = jax.jit(lambda p, m: pipe_loss(p, m, mesh))
+            counts = collective_counts(f, sh_params, sh_mbs)
+        assert counts["collective-permute"] == 2 * 4 + 1, counts
+        assert counts["all-gather"] == 0, counts
 
     def test_pp1_fallback_matches(self):
         params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
