@@ -102,7 +102,8 @@ _PROBE_SRC = (
 
 
 def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
-                   delay_s: float = 15.0, platform: str | None = None):
+                   delay_s: float = 15.0, platform: str | None = None,
+                   direct: bool = False, connect_timeout_s: float = 300.0):
     # worst-case probe budget ~3.6 min: must stay comfortably inside the
     # driver's own bench timeout so a wedged chip yields the DIAGNOSTIC JSON
     # (with last_measured evidence), never an rc=124 with no output
@@ -123,6 +124,53 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
 
         jax.config.update("jax_platforms", platform)
         return jax.devices()[0], None
+
+    if direct:
+        # Round-4 connection discipline: do NOT burn a throwaway probe
+        # connection.  Evidence (bench_results/probe_r4.log): the tunnelled
+        # backend answered the FIRST client after a quiet period, then wedged
+        # for every subsequent client — so each client teardown appears to
+        # cost a wedge window, and round-3's 7-minute probe cadence may have
+        # perpetuated its outage.  Here the process that will run the bench
+        # connects in-process, guarded by a watchdog thread: if jax.devices()
+        # (which has no timeout and poisons the process when the tunnel
+        # hangs) doesn't come back in ``connect_timeout_s``, exit(86) so the
+        # outer retry loop can back off for a long quiet gap.
+        import os
+        import signal
+        import subprocess
+        import threading
+
+        def _abort():
+            log(f"bench: direct connect watchdog fired after "
+                f"{connect_timeout_s:.0f}s — exiting 86")
+            os._exit(86)
+
+        watchdog = threading.Timer(connect_timeout_s, _abort)
+        watchdog.daemon = True
+        watchdog.start()
+        # The Timer alone is not enough: a hung PJRT init can sit in a native
+        # call that never releases the GIL (the tunnel client's gRPC path has
+        # no gil_scoped_release), starving every Python thread including the
+        # watchdog.  A separate killer PROCESS delivers SIGKILL regardless of
+        # this process's GIL state; rc then reads 137 instead of 86.
+        killer = subprocess.Popen(
+            [sys.executable, "-c",
+             "import os,sys,time,signal\n"
+             f"time.sleep({connect_timeout_s + 10.0})\n"
+             f"os.kill({os.getpid()}, signal.SIGKILL)\n"],
+        )
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            d = jax.devices()[0]
+            jnp.zeros(8).block_until_ready()  # liveness, not just handshake
+        finally:
+            watchdog.cancel()
+            killer.send_signal(signal.SIGKILL)
+        log(f"bench: direct backend acquire ok ({d.platform} {d.device_kind})")
+        return d, None
 
     last = ""
     for attempt in range(retries):
@@ -328,9 +376,18 @@ def main() -> None:
     ap.add_argument("--probe-deeper", action="store_true",
                     help="also try one layer past the HBM estimate (manual "
                          "sessions only — an OOM can wedge the tunnelled chip)")
+    ap.add_argument("--direct", action="store_true",
+                    help="skip the subprocess availability probe and connect "
+                         "in-process under a watchdog (exit 86 on a hung "
+                         "connect). Avoids the probe's own client teardown, "
+                         "which can wedge the tunnelled backend.")
+    ap.add_argument("--connect-timeout", type=float, default=300.0,
+                    help="--direct watchdog budget for jax.devices()")
     args = ap.parse_args()
 
-    dev, backend_err = acquire_device(platform=args.platform)
+    dev, backend_err = acquire_device(platform=args.platform,
+                                      direct=args.direct,
+                                      connect_timeout_s=args.connect_timeout)
     if dev is None:
         fail_json(f"no backend available: {backend_err}")
         return
