@@ -50,7 +50,7 @@ LAST_MEASURED = {
 }
 
 _LAST_MEASURED_PATH = "bench_results/last_measured.json"
-_MEASURED_LOG = "bench_results/r3_v5e_measured.jsonl"
+_MEASURED_LOG = "bench_results/r4_measured.jsonl"
 
 
 def load_last_measured() -> dict:
@@ -64,9 +64,10 @@ def load_last_measured() -> dict:
         return LAST_MEASURED
 
 
-def record_measurement(payload: dict) -> None:
-    """Append the successful on-hardware line to the evidence log and refresh
-    last_measured.json — the builder-recorded trail survives later outages."""
+def record_measurement(payload: dict, refresh_last: bool = True) -> None:
+    """Append the successful on-hardware line to the evidence log and (unless
+    ``refresh_last=False`` — low-fidelity calibration runs) refresh
+    last_measured.json, the authoritative line later diagnostics cite."""
     import os
 
     base = os.path.dirname(os.path.abspath(__file__))
@@ -75,8 +76,9 @@ def record_measurement(payload: dict) -> None:
         line = {"date": time.strftime("%Y-%m-%d"), **payload}
         with open(os.path.join(base, _MEASURED_LOG), "a") as f:
             f.write(json.dumps(line) + "\n")
-        with open(os.path.join(base, _LAST_MEASURED_PATH), "w") as f:
-            json.dump(line, f, indent=1)
+        if refresh_last:
+            with open(os.path.join(base, _LAST_MEASURED_PATH), "w") as f:
+                json.dump(line, f, indent=1)
     except Exception as e:  # noqa: BLE001 — recording must never fail the bench
         log(f"bench: could not record measurement: {e}")
 
@@ -138,7 +140,6 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         # outer retry loop can back off for a long quiet gap.
         import os
         import signal
-        import subprocess
         import threading
 
         def _abort():
@@ -169,6 +170,7 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         finally:
             watchdog.cancel()
             killer.send_signal(signal.SIGKILL)
+            killer.wait()  # reap — a zombie would linger for the whole run
         log(f"bench: direct backend acquire ok ({d.platform} {d.device_kind})")
         return d, None
 
@@ -298,7 +300,8 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         def loss_fn(p, batch, step_key):
             return llama.forward(p, batch, cfg, policy)
 
-        step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy)
+        step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy,
+                               param_specs=pspecs)
         jstep = jit_train_step(step, mesh, pspecs, ospecs)
 
         ids = jax.random.randint(
@@ -383,6 +386,10 @@ def main() -> None:
                          "which can wedge the tunnelled backend.")
     ap.add_argument("--connect-timeout", type=float, default=300.0,
                     help="--direct watchdog budget for jax.devices()")
+    ap.add_argument("--calibration", action="store_true",
+                    help="low-fidelity connect-reliability run: append to the "
+                         "measured log but do NOT refresh last_measured.json "
+                         "(the authoritative headline line)")
     args = ap.parse_args()
 
     dev, backend_err = acquire_device(platform=args.platform,
@@ -517,7 +524,7 @@ def main() -> None:
     if backend_err:
         payload["backend_retries"] = backend_err
     if on_tpu:
-        record_measurement(payload)
+        record_measurement(payload, refresh_last=not args.calibration)
     emit(payload)
 
 

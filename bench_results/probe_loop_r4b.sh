@@ -15,20 +15,24 @@
 # Discipline:
 #   - No throwaway probe connections.  Every attempt IS the bench process
 #     (bench.py --direct), connecting in-process under a watchdog (exit 86 on
-#     hung connect).  A successful connect runs the full two-regime bench and
-#     self-records to bench_results/{r3_v5e_measured.jsonl,last_measured.json}.
+#     hung connect, SIGKILL backstop if the hang holds the GIL).  A successful
+#     connect runs the full two-regime bench and self-records to
+#     bench_results/{r4_measured.jsonl,last_measured.json}.
 #   - 20 min of TOTAL TPU silence between attempts (nothing else in the
 #     session may touch the TPU while this loop runs).
-#   - After the first recorded full bench: up to 3 spaced-out light re-runs to
-#     calibrate connect reliability (can the driver's round-end bench.py
-#     expect a live backend?), then permanent silence for the driver capture.
+#   - After the first recorded full bench: up to 3 spaced-out --calibration
+#     re-runs (append to the jsonl, do NOT clobber last_measured.json) to
+#     calibrate connect reliability — can the driver's round-end bench.py
+#     expect a live backend? — then permanent silence for the driver capture.
 LOG=/root/repo/bench_results/probe_r4.log
 BLOG=/root/repo/bench_results/bench_r4_auto.log
+JSONL=/root/repo/bench_results/r4_measured.jsonl
 cd /root/repo || exit 1
+touch "$JSONL"
 STAMP=$(date +%s)
 success=0
 post=0
-echo "=== loop r4b start $(date -u +%H:%M:%S) — initial quiet gap ===" >> "$LOG"
+echo "=== loop r4b(v2) start $(date -u +%H:%M:%S) — initial quiet gap ===" >> "$LOG"
 sleep 1200
 for i in $(seq 1 30); do
   phase=main; [ "$success" = 1 ] && phase=post
@@ -38,12 +42,12 @@ for i in $(seq 1 30); do
       python bench.py --direct >> "$BLOG" 2>&1
   else
     timeout 1800 env PYTHONPATH=/root/repo:/root/.axon_site \
-      python bench.py --direct --regime bf16 --steps 5 --warmup 2 >> "$BLOG" 2>&1
+      python bench.py --direct --calibration --regime bf16 --steps 5 --warmup 2 \
+      >> "$BLOG" 2>&1
   fi
   rc=$?
   echo "attempt $i rc=$rc at $(date -u +%H:%M:%S)" >> "$LOG"
-  if [ -f bench_results/last_measured.json ] && \
-     [ "$(stat -c %Y bench_results/last_measured.json)" -gt "$STAMP" ]; then
+  if [ "$(stat -c %Y "$JSONL")" -gt "$STAMP" ]; then
     STAMP=$(date +%s)
     if [ "$success" = 0 ]; then
       echo "FULL BENCH RECORDED at $(date -u +%H:%M:%S)" >> "$LOG"

@@ -463,6 +463,7 @@ class Trainer:
             log_gradient_norm=bool(exp_block.get("log_gradient_norm", False)),
             trainable_mask=trainable,
             ema_cfg=ema_cfg,
+            param_specs=pspecs,
         )
         # NARROWED EMA workaround (round 3): donating an opt state that
         # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)

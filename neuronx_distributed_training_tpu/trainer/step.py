@@ -56,6 +56,7 @@ def make_train_step(
     log_gradient_norm: bool = False,
     trainable_mask: Any = None,  # peft.lora.trainable_mask for LoRA freeze
     ema_cfg: Any = None,  # optim.adamw.EMAConfig; state must carry an "ema" tree
+    param_specs: Any = None,  # pin grads to the param sharding (see below)
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``."""
@@ -102,6 +103,20 @@ def make_train_step(
             loss = loss_sum * inv
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
             aux = {k: jnp.mean(v) for k, v in aux_stack.items()}
+
+        if param_specs is not None:
+            # Pin gradients to the PARAM sharding at the loss->optimizer
+            # boundary.  ZeRO-1 moments can be sharded on a dim the param
+            # spec leaves free (e.g. the embed table's hidden dim over
+            # ``data`` when vocab is taken by ``model``); without this pin
+            # the partitioner back-propagates that layout into the
+            # activation-cotangent chain — observed as an "involuntary full
+            # rematerialization" on the pp x cp mesh — instead of resharding
+            # the small [vocab, h] grad right here.
+            grads = jax.tree_util.tree_map(
+                lambda s, g: shd.constrain(g, s), param_specs, grads,
+                is_leaf=lambda x: isinstance(x, P),
+            )
 
         lr = lr_schedule(opt_state["step"])
         new_params, new_opt_state, opt_metrics = adamw_update(

@@ -119,7 +119,7 @@ def main() -> None:
         step = make_train_step(
             loss_fn, AdamWConfig(grad_clip_norm=1.0),
             linear_annealing_with_warmup(1e-4, 10, 100), policy,
-            num_microbatches=1,
+            num_microbatches=1, param_specs=pspecs,
         )
         jstep = jit_train_step(step, mesh, pspecs, ospecs,
                                batch_spec=P(("data", "expert")))
