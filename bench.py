@@ -106,16 +106,20 @@ _PROBE_SRC = (
 def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
                    delay_s: float = 15.0, platform: str | None = None,
                    direct: bool = False, connect_timeout_s: float = 300.0):
-    # worst-case probe budget ~3.6 min: must stay comfortably inside the
+    # worst-case acquire budget: direct (default) ~connect_timeout+10s;
+    # legacy subprocess probe ~3.6 min.  Both stay comfortably inside the
     # driver's own bench timeout so a wedged chip yields the DIAGNOSTIC JSON
     # (with last_measured evidence), never an rc=124 with no output
     """Get a usable JAX device without risking an indefinite in-process hang.
 
     The tunnelled TPU backend can hang or be transiently UNAVAILABLE (round-1
-    failure mode: rc=1 at driver bench time).  ``jax.devices()`` has no timeout
-    and a hung call poisons the process, so availability is probed in a
-    SUBPROCESS with a hard timeout first; only after a successful probe do we
-    initialize in-process.  Returns (device | None, diagnostic | None).
+    failure mode: rc=1 at driver bench time), and ``jax.devices()`` has no
+    timeout — a hung call poisons the process.  Default (``direct=True``):
+    connect in-process under a watchdog + killer pair (see below) so the
+    bench itself is the one and only client connection — a throwaway probe's
+    teardown can wedge the tunnelled backend (bench_results/r4_notes.md).
+    Legacy (``direct=False``): probe availability in a SUBPROCESS with a hard
+    timeout first.  Returns (device | None, diagnostic | None).
     """
     import subprocess
 
@@ -154,12 +158,31 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         # call that never releases the GIL (the tunnel client's gRPC path has
         # no gil_scoped_release), starving every Python thread including the
         # watchdog.  A separate killer PROCESS delivers SIGKILL regardless of
-        # this process's GIL state; rc then reads 137 instead of 86.
+        # this process's GIL state (rc 137 instead of 86) — and because it
+        # inherits stdout it is also the SOLE emitter of the hung-connect
+        # diagnostic JSON: it can print it even when the parent is frozen,
+        # preserving the "every error path emits the JSON line" contract.
+        # Timeline: watchdog exits 86 at T (GIL-free hang) or the killer
+        # SIGKILLs at T+10 (GIL-held hang); either way the killer prints the
+        # diagnostic exactly once at T+10.  On success the killer dies first
+        # and prints nothing.
+        diag = json.dumps({
+            "metric": "llama3_8B_pretrain_mfu",
+            "value": 0.0,
+            "unit": "percent_mfu",
+            "vs_baseline": 0.0,
+            "error": f"backend connect hung > {connect_timeout_s:.0f}s "
+                     f"(direct in-process acquire)",
+            "last_measured": load_last_measured(),
+        })
         killer = subprocess.Popen(
             [sys.executable, "-c",
-             "import os,sys,time,signal\n"
+             "import contextlib,os,sys,time,signal\n"
              f"time.sleep({connect_timeout_s + 10.0})\n"
-             f"os.kill({os.getpid()}, signal.SIGKILL)\n"],
+             "print(sys.argv[1], flush=True)\n"
+             "with contextlib.suppress(ProcessLookupError):\n"
+             f"    os.kill({os.getpid()}, signal.SIGKILL)\n",
+             diag],
         )
         try:
             import jax
@@ -379,11 +402,17 @@ def main() -> None:
     ap.add_argument("--probe-deeper", action="store_true",
                     help="also try one layer past the HBM estimate (manual "
                          "sessions only — an OOM can wedge the tunnelled chip)")
-    ap.add_argument("--direct", action="store_true",
-                    help="skip the subprocess availability probe and connect "
-                         "in-process under a watchdog (exit 86 on a hung "
-                         "connect). Avoids the probe's own client teardown, "
-                         "which can wedge the tunnelled backend.")
+    ap.add_argument("--direct", action="store_true", default=True,
+                    help="connect in-process under a watchdog (exit 86 + "
+                         "diagnostic JSON on a hung connect) instead of "
+                         "burning a throwaway subprocess probe connection — "
+                         "each client teardown can wedge the tunnelled "
+                         "backend (bench_results/probe_r4.log). DEFAULT so "
+                         "the driver's round-end capture is itself the one "
+                         "and only client connection.")
+    ap.add_argument("--probe-subprocess", dest="direct", action="store_false",
+                    help="legacy acquire: probe availability in a subprocess "
+                         "first (costs an extra client teardown)")
     ap.add_argument("--connect-timeout", type=float, default=300.0,
                     help="--direct watchdog budget for jax.devices()")
     ap.add_argument("--calibration", action="store_true",
@@ -523,6 +552,12 @@ def main() -> None:
         payload["regime_errors"] = errors
     if backend_err:
         payload["backend_retries"] = backend_err
+    if args.calibration:
+        # low-fidelity connect-reliability line — must be distinguishable
+        # from headline measurements by any later reader of the jsonl
+        payload["calibration"] = True
+        payload["steps"] = steps
+        payload["warmup"] = warmup
     if on_tpu:
         record_measurement(payload, refresh_last=not args.calibration)
     emit(payload)

@@ -1,0 +1,82 @@
+"""Pipeline compiled-FLOPs parity regression gate (VERDICT r3 item 2).
+
+The reference computes loss only on the last pipeline stage
+(``base.py:378-381``).  This repo hoists the embed and the lm-head/CE out of
+the SPMD wavefront (``parallel/pipeline.py``), so at equal tokens the
+pipelined step's compiled FLOPs must stay within a few percent of the
+unpipelined step — the residual is bubble-tick stage compute inherent to the
+SPMD schedule.  Measured 1.0205x at pp=4 (bench_results/pp_flops_r4.md); this
+test pins the property so a future pipeline change cannot silently regress to
+the every-rank-every-tick head (which costs ``pp*(nm+pp-1)/nm``x head FLOPs,
+4.75x at this shape).
+
+Vocab >> hidden so the head term dominates, mirroring Llama-3's 128k vocab.
+"""
+
+import json
+
+import jax
+import pytest
+
+from neuronx_distributed_training_tpu.config.loader import load_config
+from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+# the probe's exact shape (tools/pp_flops_probe.py, measured ratio 1.0205):
+# the residual bubble term scales as (nm+pp-1)/nm on the stage fraction, so a
+# smaller global batch (nm=8 instead of 16) reads ~1.15 — shape matters
+HIDDEN = 128
+LAYERS = 8
+SEQ = 256
+VOCAB = 8192
+GBS = 32
+
+
+def _cfg(pp: int) -> dict:
+    return {
+        "name": f"flopsgate_pp{pp}",
+        "model_source": "hf",
+        "seed": 0,
+        "trainer": {"max_steps": 1, "log_every_n_steps": 1},
+        "distributed_strategy": {
+            "pipeline_model_parallel_size": pp,
+            "tensor_model_parallel_size": 1,
+        },
+        "data": {"global_batch_size": GBS, "micro_batch_size": 1,
+                 "seq_length": SEQ, "synthetic": True},
+        "model": {
+            "vocab_size": VOCAB,
+            "hidden_size": HIDDEN,
+            "intermediate_size": 2 * HIDDEN,
+            "num_layers": LAYERS,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 4,
+            "max_position_embeddings": SEQ,
+            "activations_checkpoint_granularity": "full",
+            "optim": {"name": "adamw_fp32OptState", "lr": 1e-4,
+                      "sched": {"name": "constant"}},
+        },
+        "precision": {"type": "fp32"},
+    }
+
+
+def _compiled_flops(pp: int) -> float:
+    t = Trainer.from_config(load_config(_cfg(pp)), enable_checkpointing=False)
+    batch = next(t.data_module.sharded_batches(t.mesh))
+    compiled = t.train_step.lower(
+        t.params, t.opt_state, batch, jax.random.PRNGKey(0)
+    ).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca.get("flops", -1.0))
+
+
+@pytest.mark.slow  # two full-train-step compiles on the 8-device mesh
+def test_pp4_compiled_flops_within_10pct_of_unpipelined():
+    f1 = _compiled_flops(1)
+    f4 = _compiled_flops(4)
+    assert f1 > 0 and f4 > 0, (f1, f4)
+    ratio = f4 / f1
+    # measured 1.0205 (pp_flops_r4.md); 1.10 leaves margin for XLA version
+    # drift while still catching the 4.75x-head-class regression by a mile
+    assert ratio < 1.10, json.dumps({"pp4_flops": f4, "pp1_flops": f1,
+                                     "ratio": round(ratio, 4)})
