@@ -50,7 +50,7 @@ LAST_MEASURED = {
 }
 
 _LAST_MEASURED_PATH = "bench_results/last_measured.json"
-_MEASURED_LOG = "bench_results/r4_measured.jsonl"
+_MEASURED_LOG = "bench_results/r5_measured.jsonl"
 
 
 def load_last_measured() -> dict:
@@ -146,9 +146,22 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         import signal
         import threading
 
+        # Failure-mode discrimination (VERDICT r4 item 8): each connect phase
+        # logs on ENTRY (log() flushes stderr), so even when the killer has to
+        # SIGKILL a GIL-held hang, the loop log shows the last phase reached —
+        # "import" (local), "plugin-init" (PJRT handshake through the relay),
+        # or "first-rpc" (listener accepted but the data path is wedged).
+        phase = {"name": "import-jax", "t0": time.perf_counter()}
+
+        def enter_phase(name: str) -> None:
+            phase.update(name=name, t0=time.perf_counter())
+            log(f"bench: connect phase: {name}")
+
         def _abort():
             log(f"bench: direct connect watchdog fired after "
-                f"{connect_timeout_s:.0f}s — exiting 86")
+                f"{connect_timeout_s:.0f}s — exiting 86 (hung in phase "
+                f"'{phase['name']}' for "
+                f"{time.perf_counter() - phase['t0']:.0f}s)")
             os._exit(86)
 
         watchdog = threading.Timer(connect_timeout_s, _abort)
@@ -175,26 +188,64 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
                      f"(direct in-process acquire)",
             "last_measured": load_last_measured(),
         })
+        # The killer verifies the target is still THIS process before SIGKILL
+        # (ADVICE r4: the parent may have exited at T via the watchdog and its
+        # PID been reused within the 10 s grace window on a busy host) by
+        # comparing /proc/<pid>/stat's starttime field captured at spawn.
+        def _starttime(pid: int) -> str:
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    return f.read().rsplit(")", 1)[1].split()[19]
+            except Exception:  # noqa: BLE001 — non-Linux fallback: no check
+                return ""
+
+        me = os.getpid()
         killer = subprocess.Popen(
             [sys.executable, "-c",
              "import contextlib,os,sys,time,signal\n"
              f"time.sleep({connect_timeout_s + 10.0})\n"
              "print(sys.argv[1], flush=True)\n"
-             "with contextlib.suppress(ProcessLookupError):\n"
-             f"    os.kill({os.getpid()}, signal.SIGKILL)\n",
-             diag],
+             "def _start(pid):\n"
+             "    try:\n"
+             "        with open(f'/proc/{pid}/stat') as f:\n"
+             "            return f.read().rsplit(')', 1)[1].split()[19]\n"
+             "    except Exception:\n"
+             "        return sys.argv[2]\n"
+             f"if _start({me}) == sys.argv[2]:\n"
+             "    with contextlib.suppress(ProcessLookupError):\n"
+             f"        os.kill({me}, signal.SIGKILL)\n",
+             diag, _starttime(me)],
         )
         try:
-            import jax
-            import jax.numpy as jnp
+            try:
+                import jax
+                import jax.numpy as jnp
 
-            d = jax.devices()[0]
-            jnp.zeros(8).block_until_ready()  # liveness, not just handshake
-        finally:
-            watchdog.cancel()
-            killer.send_signal(signal.SIGKILL)
-            killer.wait()  # reap — a zombie would linger for the whole run
-        log(f"bench: direct backend acquire ok ({d.platform} {d.device_kind})")
+                enter_phase("plugin-init (jax.devices / PJRT handshake)")
+                d = jax.devices()[0]
+                t_init = time.perf_counter() - phase["t0"]
+                enter_phase("first-rpc (tiny buffer round-trip)")
+                jnp.zeros(8).block_until_ready()  # liveness, not just handshake
+                t_rpc = time.perf_counter() - phase["t0"]
+            finally:
+                watchdog.cancel()
+                killer.send_signal(signal.SIGKILL)
+                killer.wait()  # reap — a zombie would linger for the whole run
+        except Exception as e:  # noqa: BLE001 — ADVICE r4: a FAST-raising
+            # connect (round-1 "transiently UNAVAILABLE, rc=1" mode) must
+            # return a diagnostic, not crash past the only JSON emitter
+            return None, (f"direct connect raised in phase '{phase['name']}': "
+                          f"{type(e).__name__}: {e}")
+        # ADVICE r4: if the plugin fails fast JAX can silently fall back to
+        # CPU and we'd emit a success-shaped CPU line.  JAX_PLATFORMS=axon in
+        # the env should prevent that, but pin it explicitly.
+        want_tpu = platform == "tpu" or (
+            platform is None
+            and os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"))
+        if want_tpu and d.platform == "cpu":
+            return None, "wanted tpu, got platform=cpu (silent CPU fallback)"
+        log(f"bench: direct backend acquire ok ({d.platform} {d.device_kind}) "
+            f"plugin-init={t_init:.2f}s first-rpc={t_rpc:.2f}s")
         return d, None
 
     last = ""

@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-5 patient capture loop — connection-discipline model, now with
+# failure-mode discrimination (VERDICT r4 item 8).
+#
+# Evidence going in (probe_r5.log attempt 1, 19:52 UTC): first client of the
+# round hung in phase 'plugin-init (PJRT handshake)' — while BOTH local relay
+# ports (2024, 48271) accept raw TCP.  So "relay down" is ruled out; the
+# listener behind the relay is wedged.  Each attempt here logs the hung phase
+# (bench.py logs phase entry) plus before/after TCP state, giving the
+# per-attempt evidence round 4 lacked.
+#
+# Discipline (bench_results/r4_notes.md): every attempt IS the bench process
+# (one client, no throwaway probes); quiet gaps between attempts escalate
+# 30/30/30/45/60/60… min since round-4's fixed 25-min cadence never landed a
+# second connect (T > 25 min or permanent that day).  On the first recorded
+# full bench, run the chip-gated queue in VERDICT order — kernel
+# revalidation, --probe-deeper, EMA donation probe, flash tile re-sweep at
+# depth — one client per quiet window, then go silent for the driver.
+LOG=/root/repo/bench_results/probe_r5.log
+BLOG=/root/repo/bench_results/bench_r5_auto.log
+JSONL=/root/repo/bench_results/r5_measured.jsonl
+cd /root/repo || exit 1
+touch "$JSONL"
+STAMP=$(stat -c %Y "$JSONL")
+
+tcp_state() {
+  local s=""
+  for p in 2024 48271; do
+    if timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/$p" 2>/dev/null; then
+      s="$s $p=open"
+    else
+      s="$s $p=closed"
+    fi
+  done
+  echo "$s"
+}
+
+END=$(( $(date +%s) + 34200 ))   # permanent silence 9.5 h from loop start
+gaps=(1800 1800 1800 2700 3600 3600)
+i=0
+echo "=== loop r5 start $(date -u +%H:%M:%S) ===" >> "$LOG"
+while [ "$(date +%s)" -lt "$END" ]; do
+  g=${gaps[$(( i < 5 ? i : 5 ))]}
+  sleep "$g"
+  i=$((i + 1))
+  echo "=== attempt $i $(date -u +%H:%M:%S) tcp:$(tcp_state) ===" >> "$LOG"
+  timeout 5400 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python bench.py --direct >> "$BLOG" 2>&1
+  rc=$?
+  echo "attempt $i rc=$rc at $(date -u +%H:%M:%S) tcp_after:$(tcp_state)" >> "$LOG"
+  if [ "$(stat -c %Y "$JSONL")" -gt "$STAMP" ]; then
+    STAMP=$(stat -c %Y "$JSONL")
+    echo "FULL BENCH RECORDED at $(date -u +%H:%M:%S) — chip-gated queue" >> "$LOG"
+    while read -r item; do
+      [ -z "$item" ] && continue
+      sleep 1500
+      echo "--- queue: $item $(date -u +%H:%M:%S) tcp:$(tcp_state)" >> "$LOG"
+      timeout 3600 env PYTHONPATH=/root/repo:/root/.axon_site \
+        $item >> "$BLOG" 2>&1
+      echo "--- queue rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+    done <<'QUEUE'
+python tools/kernel_revalidation.py
+python bench.py --probe-deeper
+python tools/ema_donation_probe.py
+python bench.py --calibration --regime bf16 --steps 6 --warmup 2 --block-kv 1024
+python bench.py --calibration --regime bf16 --steps 6 --warmup 2 --block-kv 4096
+QUEUE
+    echo "queue done at $(date -u +%H:%M:%S) — silent for driver capture" >> "$LOG"
+    exit 0
+  fi
+done
+echo "loop expired without a recorded bench at $(date -u +%H:%M:%S)" >> "$LOG"

@@ -398,3 +398,363 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
     )
     aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
     return park, aux_total
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: single-pass schedule with in-loop pipe-sharded head and manual grads
+# ---------------------------------------------------------------------------
+#
+# The GPipe-wavefront-with-autodiff above is transparent to ``jax.grad`` but
+# pays for it in memory: autodiff of the tick scan retains one stage input per
+# tick — O(nm + pp) activation-sized residuals per rank (measured 0.45 GiB/tick
+# at flagship shape, bench_results/pp_memory_flagship.md).  The reference's
+# engine instead runs 1F1B (``base.py:374-383``): backward for microbatch m
+# starts as soon as its forward leaves the last stage, bounding in-flight
+# activations to O(pp).
+#
+# ``pipeline_loss_and_grad`` is the TPU-native 1F1B: ONE ``lax.scan`` in which
+# rank ``r`` runs forward of microbatch ``m`` at tick ``m + r`` and backward of
+# ``m`` at tick ``m + 2*pp - 1 - r`` (the classic 1F1B steady state: one F and
+# one B per rank per tick).  Because JAX autodiff cannot interleave a scan's
+# backward into its forward, the backward is MANUAL: each B tick calls
+# ``jax.vjp`` on the stage (recompute-and-backprop within the tick — the same
+# FLOPs as the wavefront's rematerialized backward), activation cotangents ride
+# the reverse ring, and parameter gradients accumulate in the scan carry.
+# Saved state is a 2*pp-slot ring buffer of stage inputs — the O(pp) class.
+#
+# The lm-head + CE cannot stay hoisted (its cotangent would be needed before
+# the forward scan ends), so it moves INSIDE the tick loop, sharded over
+# ``pipe`` on the VOCAB dim: when microbatch m finishes at tick m + pp - 1 its
+# output is broadcast over the pipe ring (one psum) and every rank computes
+# logits for its V/pp vocab slice — total head FLOPs stay at parity with the
+# unpipelined step (the property tests/test_pp_flops_parity.py pins), and the
+# closed-form CE backward (softmax - onehot) yields dy in the same tick.
+# This works because both backward seeds are known before the loss value:
+# d(loss)/d(loss_sum) = 1/denom_total (denom is a function of labels only) and
+# d(loss)/d(stage aux) = aux_scale.
+#
+# Scope: vp == 1, plain matmul head (tied embed or lm_head.w), token-level CE
+# (pretrain/SFT).  vp > 1, preference alignment, and exotic heads keep the
+# autodiff wavefront — ``supports_1f1b`` is the gate.
+
+
+def _tree_index(tree, i):
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, keepdims=False), tree
+    )
+
+
+def ce_denominator(microbatches: dict, *, shift_labels: bool,
+                   ignore_index: int = -100) -> jax.Array:
+    """Total valid-token count over all microbatches — a function of labels
+    only, which is what lets 1F1B seed the CE backward before the forward
+    finishes.  Matches the masking in ``ops.cross_entropy``."""
+    labels = microbatches["labels"]
+    loss_mask = microbatches.get("loss_mask")
+    if shift_labels:
+        labels = labels[..., 1:]
+        loss_mask = None if loss_mask is None else loss_mask[..., 1:]
+    valid = (labels != ignore_index).astype(jnp.float32)
+    if loss_mask is not None:
+        valid = valid * loss_mask.astype(jnp.float32)
+    return jnp.sum(valid)
+
+
+def pipeline_loss_and_grad(
+    params: Any,
+    layer_params: Any,  # [num_layers, ...] with dim0 sharded over "pipe"
+    microbatches: dict[str, jax.Array],  # leaves [num_micro, mb, ...]
+    *,
+    embed_fn: EmbedFn,
+    stage_fn: StageFn,
+    head_hidden_fn: Callable,  # (head_params, y) -> h   (final norm / identity)
+    head_params: Any,          # pytree whose grads flow through head_hidden_fn
+    head_weight: jax.Array,    # [V, H] — logits = h @ W.T; pipe-sharded on V
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+    stage_aux: bool = False,
+    aux_scale: float = 0.0,
+    shift_labels: bool = True,
+    grad_dtype=jnp.float32,
+    ignore_index: int = -100,
+):
+    """1F1B pipeline step: returns ``(loss, grads)`` where ``grads`` has
+    entries ``{"layers", "embed_cotangent", "head_params", "head_weight"}``:
+
+    - ``layers``: [L, ...] tree, pipe-sharded like ``layer_params``;
+    - ``embed_cotangent``: cotangent of the PERMUTED embed feed
+      ``vmap(embed_fn)(mb_perm)`` (same [pp*slots, mb, s, h] layout /
+      pipe sharding as the feed) — pull it through ``jax.vjp`` of the embed
+      computation to get embedding-table grads;
+    - ``head_params``: grads of ``head_hidden_fn``'s params (final norm);
+    - ``head_weight``: [V, H] grad of the head matmul (add to the embed table
+      grad when tied).
+
+    Loss matches ``pipeline_loss`` (same masking and normalization); the
+    caller divides nothing — normalization by the global valid-token count is
+    already inside.
+    """
+    mesh = mesh or shd.active_mesh()
+    pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
+    nm = num_microbatches or jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    if pp <= 1:
+        raise ValueError("pipeline_loss_and_grad requires pp > 1")
+
+    from jax.sharding import PartitionSpec as P
+
+    denom = jnp.maximum(ce_denominator(
+        microbatches, shift_labels=shift_labels, ignore_index=ignore_index
+    ), 1.0)
+
+    # round-robin embed feed, identical to pipeline_loss: row g = r*slots + l
+    # <-> microbatch m = l*pp + r, dim 0 sharded over pipe
+    slots = -(-nm // pp)
+    g = np.arange(pp * slots)
+    m_of_g = (g % slots) * pp + g // slots
+    m_idx = np.where(m_of_g < nm, m_of_g, 0)
+    mb_perm = jax.tree_util.tree_map(lambda x: x[m_idx], microbatches)
+
+    def emb_of(p):
+        e = jax.vmap(lambda m: embed_fn(p, m))(mb_perm)
+        unc = P.UNCONSTRAINED
+        return shd.constrain(e, P(PIPE_AXIS, *([unc] * (e.ndim - 1))))
+
+    emb, emb_vjp = jax.vjp(emb_of, params)
+
+    body = functools.partial(
+        _onef1b_body,
+        stage_fn=stage_fn, head_hidden_fn=head_hidden_fn, pp=pp, nm=nm,
+        slots=slots, stage_aux=stage_aux, aux_scale=float(aux_scale),
+        shift_labels=shift_labels, grad_dtype=grad_dtype,
+        ignore_index=ignore_index,
+    )
+    layer_spec = P(PIPE_AXIS)
+    vocab_spec = P(PIPE_AXIS, *([None] * (head_weight.ndim - 1)))
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_spec, P(), P(), vocab_spec, P(PIPE_AXIS), P()),
+        out_specs=(P(), layer_spec, P(PIPE_AXIS), vocab_spec, P(), P()),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+    loss_sum, d_layers, d_emb, d_w, d_head_params, aux_total = fn(
+        layer_params, head_params, microbatches, head_weight, emb, denom
+    )
+    loss = loss_sum / denom + aux_scale * aux_total
+    (d_params_embed,) = emb_vjp(d_emb.astype(emb.dtype))
+    grads = {
+        "layers": d_layers,
+        "params_from_embed": d_params_embed,
+        "head_params": d_head_params,
+        "head_weight": d_w,
+    }
+    return loss, grads
+
+
+def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
+                 stage_fn, head_hidden_fn, pp, nm, slots, stage_aux, aux_scale,
+                 shift_labels, grad_dtype, ignore_index):
+    """Per-pipe-rank 1F1B tick loop (inside shard_map, manual over "pipe").
+
+    Tick algebra (rank ``r``, tick ``t``):
+      forward of microbatch ``m_F = t - r``           (valid in [0, nm))
+      head (all ranks, vocab-sliced) of ``m_H = t - (pp-1)``
+      backward of ``m_B = t - (2*pp - 1) + r``        (valid in [0, nm))
+    ``T = nm + 2*pp - 1`` ticks total.  The head's dy for ``m`` lands in the
+    ``dy_next`` carry at tick ``m + pp - 1`` and the last rank consumes it one
+    tick later — exactly when its B(m) is scheduled.  Every collective
+    (forward ring hop, reverse ring hop, head psums, embed feed and embed-
+    cotangent routing switches) executes unconditionally or under tick-only
+    gates, so all devices always reach the same rendezvous.
+    """
+    rank = jax.lax.axis_index(PIPE_AXIS)
+    is_first = rank == 0
+    is_last = rank == pp - 1
+    vr = w_r.shape[0]  # local vocab slice size
+
+    x0 = emb[0]
+    cyclic = [(i, (i + 1) % pp) for i in range(pp)]
+    reverse = [((i + 1) % pp, i) for i in range(pp)]
+    buf_n = 2 * pp
+
+    def stage_flat(lp, x, mb):
+        out = stage_fn(lp, x, {**mb, "_chunk": jnp.zeros((), jnp.int32)})
+        if stage_aux:
+            return out
+        return out, jnp.zeros((), jnp.float32)
+
+    def tick(carry, t):
+        (recv, cot_recv, dy_next, inflight, d_layers, d_emb, d_w, d_hp_acc,
+         loss_acc, aux_acc) = carry
+
+        # ---- forward ---------------------------------------------------
+        w_F = t - rank
+        f_valid = jnp.logical_and(w_F >= 0, w_F < nm)
+        m_F = jnp.clip(w_F, 0, nm - 1)
+        mbF = _tree_index(microbatches, m_F)
+        e_t = jax.lax.dynamic_index_in_dim(
+            emb, jnp.clip(t // pp, 0, slots - 1), 0, keepdims=False
+        )
+        fresh = jax.lax.cond(
+            t < nm,
+            lambda: jax.lax.switch(
+                jnp.remainder(t, pp),
+                [functools.partial(
+                    jax.lax.ppermute, e_t, PIPE_AXIS, [(o, 0)]
+                ) for o in range(pp)],
+            ),
+            lambda: jnp.zeros(x0.shape, x0.dtype),
+        )
+        x_in = jnp.where(is_first, fresh, recv)
+        y, s_aux = stage_flat(local_layers, x_in, mbF)
+        aux_acc = aux_acc + jnp.where(f_valid, s_aux, 0.0)
+        # save the stage input for this rank's B tick (2*pp-slot ring buffer)
+        slot_F = jnp.remainder(m_F, buf_n)
+        old = jax.lax.dynamic_index_in_dim(inflight, slot_F, 0, keepdims=False)
+        inflight = jax.lax.dynamic_update_index_in_dim(
+            inflight, jnp.where(f_valid, x_in, old), slot_F, 0
+        )
+
+        # ---- head + CE (vocab sliced over pipe) ------------------------
+        m_H = t - (pp - 1)
+        h_valid = jnp.logical_and(m_H >= 0, m_H < nm)
+        m_Hc = jnp.clip(m_H, 0, nm - 1)
+        y_bcast = jax.lax.psum(
+            jnp.where(jnp.logical_and(is_last, f_valid), y, 0.0), PIPE_AXIS
+        )
+        mbH = _tree_index(microbatches, m_Hc)
+        # hidden fn under vjp over BOTH (hp, y) so the norm-weight grad and
+        # dy fall out of one pass; the CE backward below is closed-form
+        (h_out, head_vjp) = jax.vjp(head_hidden_fn, head_params, y_bcast)
+        if shift_labels:
+            h2 = h_out[:, :-1]
+            labels2 = mbH["labels"][:, 1:]
+            lmH = mbH.get("loss_mask")
+            lm2 = None if lmH is None else lmH[:, 1:]
+        else:
+            h2 = h_out
+            labels2 = mbH["labels"]
+            lmH = mbH.get("loss_mask")
+            lm2 = lmH
+        valid = labels2 != ignore_index
+        safe = jnp.where(valid, labels2, 0)
+        mask = valid.astype(jnp.float32)
+        if lm2 is not None:
+            mask = mask * lm2.astype(jnp.float32)
+        logits = jnp.einsum(
+            "bsh,vh->bsv", h2, w_r, preferred_element_type=jnp.float32
+        )
+        gmax = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(logits, axis=-1)), PIPE_AXIS
+        )
+        shifted = logits - gmax[..., None]
+        sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), PIPE_AXIS)
+        lse = jnp.log(sumexp) + gmax
+        off = rank * vr
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+            + off == safe[..., None]
+        )
+        ll = jax.lax.psum(
+            jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1), PIPE_AXIS
+        )
+        loss_m = jnp.sum((lse - ll) * mask)
+        p_r = jnp.exp(shifted) / sumexp[..., None]
+        dlogits = (p_r - onehot.astype(jnp.float32)) * (mask / denom)[..., None]
+        dlogits = dlogits.astype(h2.dtype)
+        d_wr_t = jnp.einsum(
+            "bsv,bsh->vh", dlogits, h2, preferred_element_type=jnp.float32
+        )
+        dh2 = jax.lax.psum(
+            jnp.einsum("bsv,vh->bsh", dlogits, w_r,
+                       preferred_element_type=jnp.float32),
+            PIPE_AXIS,
+        ).astype(h_out.dtype)
+        if shift_labels:
+            dh = jnp.pad(
+                dh2, ((0, 0), (0, 1)) + ((0, 0),) * (dh2.ndim - 2)
+            )
+        else:
+            dh = dh2
+        d_hp_t, dy_t = head_vjp(dh)
+        hv = h_valid.astype(jnp.float32)
+        loss_acc = loss_acc + hv * loss_m
+        d_w = d_w + hv * d_wr_t.astype(grad_dtype)
+        d_hp_acc = jax.tree_util.tree_map(
+            lambda a, gkk: a + hv * gkk.astype(grad_dtype), d_hp_acc, d_hp_t
+        )
+        dy_new = jnp.where(h_valid, dy_t, jnp.zeros_like(dy_t))
+
+        # ---- backward --------------------------------------------------
+        m_B = t - (2 * pp - 1) + rank
+        b_valid = jnp.logical_and(m_B >= 0, m_B < nm)
+        m_Bc = jnp.clip(m_B, 0, nm - 1)
+        mbB = _tree_index(microbatches, m_Bc)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            inflight, jnp.remainder(m_Bc, buf_n), 0, keepdims=False
+        )
+        dy_in = jnp.where(is_last, dy_next, cot_recv)
+
+        def stage_for_vjp(lp, x):
+            return stage_flat(lp, x, mbB)
+
+        _, stage_vjp = jax.vjp(stage_for_vjp, local_layers, x_saved)
+        d_lp_t, d_x_t = stage_vjp(
+            (dy_in.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
+        )
+        bv = b_valid.astype(jnp.float32)
+        d_layers = jax.tree_util.tree_map(
+            lambda a, gkk: a + bv * gkk.astype(grad_dtype), d_layers, d_lp_t
+        )
+        d_x_masked = jnp.where(b_valid, d_x_t, jnp.zeros_like(d_x_t))
+
+        # embed cotangent: rank 0's d_x for microbatch m0 routes back to its
+        # round-robin owner (the reverse of the embed feed), tick-uniform
+        m0 = t - (2 * pp - 1)
+        m0_valid = jnp.logical_and(m0 >= 0, m0 < nm)
+        m0c = jnp.clip(m0, 0, nm - 1)
+        d_x0 = jnp.where(is_first, d_x_masked, jnp.zeros_like(d_x_masked))
+        routed = jax.lax.cond(
+            jnp.logical_and(t >= 2 * pp - 1, t < nm + 2 * pp - 1),
+            lambda: jax.lax.switch(
+                jnp.remainder(m0c, pp),
+                [functools.partial(
+                    jax.lax.ppermute, d_x0, PIPE_AXIS, [(0, o)]
+                ) for o in range(pp)],
+            ),
+            lambda: jnp.zeros_like(d_x0),
+        )
+        mine = jnp.logical_and(m0_valid, jnp.remainder(m0c, pp) == rank)
+        p_slot = m0c // pp
+        cur = jax.lax.dynamic_index_in_dim(d_emb, p_slot, 0, keepdims=False)
+        d_emb = jax.lax.dynamic_update_index_in_dim(
+            d_emb,
+            jnp.where(mine, routed.astype(grad_dtype), cur), p_slot, 0,
+        )
+
+        # ---- ring hops -------------------------------------------------
+        recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
+        cot_recv = jax.lax.ppermute(d_x_masked, PIPE_AXIS, reverse)
+        return (recv, cot_recv, dy_new, inflight, d_layers, d_emb, d_w,
+                d_hp_acc, loss_acc, aux_acc), None
+
+    zeros = jnp.zeros_like(x0)
+    inflight0 = jnp.zeros((buf_n,) + x0.shape, x0.dtype)
+    d_layers0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, grad_dtype), local_layers
+    )
+    d_emb0 = jnp.zeros((slots,) + x0.shape, grad_dtype)
+    d_w0 = jnp.zeros(w_r.shape, grad_dtype)
+    d_hp0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, grad_dtype), head_params
+    )
+    carry0 = (zeros, jnp.zeros_like(x0), jnp.zeros_like(x0), inflight0,
+              d_layers0, d_emb0, d_w0, d_hp0,
+              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    carry, _ = jax.lax.scan(tick, carry0, jnp.arange(nm + 2 * pp - 1))
+    (_, _, _, _, d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
+    aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
+    # loss and head grads are computed identically on every rank (the CE is
+    # psum-closed over pipe); d_w is this rank's vocab slice
+    return loss_acc, d_layers, d_emb, d_w, d_hp_acc, aux_total

@@ -1,0 +1,169 @@
+"""On-chip revalidation of the Mosaic-compiled Pallas kernels (round-5 queue).
+
+The flash-attention kernel last executed on REAL TPU in round 2; segment
+masking (round 3) and every later change has only run in Pallas interpret
+mode on the CPU mesh.  This script runs the compiled kernel on the tunnelled
+chip at real tile sizes and compares against the pure-XLA reference
+(``ops.attention_reference``) — fwd AND bwd, across the variant matrix:
+causal, GQA, segment-masked (packed sequences), sliding-window.
+
+The ring body (``parallel/ring_attention.py``) needs a multi-device ring and
+cannot execute on the single tunnelled chip; its on-chip story remains the
+flash kernel it calls per-shard, which IS covered here.  Records one JSON
+line per case to ``bench_results/kernel_reval_r5.json``.
+
+Run ON DEVICE (the axon TPU is the one client — connection discipline per
+bench_results/r4_notes.md):
+    PYTHONPATH=/root/repo:$PYTHONPATH python tools/kernel_revalidation.py
+
+Reference parity target: the NKI kernels the reference trusts in production
+(reference ``modeling_llama.py:482-489``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _ref_attention(q, k, v, *, causal, segment_ids=None, window=None):
+    """Pure-XLA reference (fp32 accumulation) mirroring ops/flash_attention
+    semantics: [b, s, h, d] layout, GQA by head-group mapping, optional
+    segments and window."""
+    qh, kh = q.shape[2], k.shape[2]
+    group = qh // kh
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s *= 1.0 / np.sqrt(q.shape[-1])
+    sq, sk = q.shape[1], k.shape[1]
+    mask = jnp.ones((sq, sk), bool)
+    if causal:
+        mask &= jnp.tril(jnp.ones((sq, sk), bool))
+    if window is not None:
+        idx = jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :]
+        mask &= idx < window
+    mask = mask[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = mask & seg
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def run_case(name: str, *, b, qh, kh, s, d, causal, segments, window,
+             block_q, block_kv) -> dict:
+    from neuronx_distributed_training_tpu.ops import flash_attention as fa
+
+    key = jax.random.PRNGKey(hash(name) % (2**31))
+    kq, kk, kv_, _ = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, qh, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, kh, d), jnp.bfloat16)
+    v = jax.random.normal(kv_, (b, s, kh, d), jnp.bfloat16)
+    seg_ids = None
+    if segments:
+        # two packed documents per row, split at a non-tile-aligned boundary
+        cut = s // 2 + 37
+        seg_ids = jnp.where(jnp.arange(s) < cut, 0, 1)[None, :].repeat(b, 0)
+
+    win = window
+
+    def flash_loss(q, k, v):
+        o = fa.flash_attention(
+            q, k, v, causal=causal, segment_ids=seg_ids,
+            sliding_window=win, block_q=block_q, block_kv=block_kv,
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    def ref_loss(q, k, v):
+        o = _ref_attention(q, k, v, causal=causal, segment_ids=seg_ids,
+                           window=win)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    t0 = time.perf_counter()
+    (gf, of) = jax.jit(jax.value_and_grad(flash_loss, argnums=(0, 1, 2),
+                                          has_aux=True))(q, k, v)
+    jax.block_until_ready(gf)
+    t_flash = time.perf_counter() - t0
+    (gr, orf) = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2),
+                                           has_aux=True))(q, k, v)
+    jax.block_until_ready(gr)
+
+    (lf, o_f), grads_f = gf, of
+    (lr, o_r), grads_r = gr, orf
+
+    def rel(a, b):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        return float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-9))
+
+    fwd_err = rel(o_f, o_r)
+    bwd_err = max(rel(a, b) for a, b in zip(grads_f, grads_r))
+    ok = fwd_err < 2e-2 and bwd_err < 5e-2  # bf16 kernel vs fp32-accum ref
+    return {
+        "case": name, "ok": bool(ok), "fwd_rel_err": round(fwd_err, 5),
+        "bwd_rel_err": round(bwd_err, 5), "compile_plus_run_s": round(t_flash, 2),
+        "block_q": block_q, "block_kv": block_kv, "shape": [b, qh, kh, s, d],
+    }
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv  # tiny shapes: CPU/interpret plumbing check
+    dev = jax.devices()[0]
+    print(f"kernel_reval: device {dev.platform} {dev.device_kind}", file=sys.stderr)
+    on_tpu = dev.platform == "tpu"
+    if smoke:
+        cases = [
+            dict(name="causal_gqa", b=1, qh=4, kh=2, s=256, d=64, causal=True,
+                 segments=False, window=None, block_q=128, block_kv=128),
+            dict(name="segment_masked", b=1, qh=2, kh=2, s=256, d=64,
+                 causal=True, segments=True, window=None, block_q=128,
+                 block_kv=128),
+        ]
+    else:
+        cases = [
+            dict(name="causal_mha", b=1, qh=8, kh=8, s=4096, d=128, causal=True,
+                 segments=False, window=None, block_q=512, block_kv=2048),
+            dict(name="causal_gqa", b=1, qh=32, kh=8, s=4096, d=128, causal=True,
+                 segments=False, window=None, block_q=512, block_kv=2048),
+            dict(name="segment_masked", b=1, qh=8, kh=8, s=4096, d=128,
+                 causal=True, segments=True, window=None, block_q=512,
+                 block_kv=2048),
+            dict(name="sliding_window", b=1, qh=8, kh=8, s=4096, d=128,
+                 causal=True, segments=False, window=1024, block_q=512,
+                 block_kv=1024),
+        ]
+    out = []
+    for c in cases:
+        name = c.pop("name")
+        try:
+            r = run_case(name, **c)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            r = {"case": name, "ok": False,
+                 "error": f"{type(e).__name__}: {str(e)[:400]}"}
+        print(json.dumps(r), file=sys.stderr)
+        out.append(r)
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "bench_results")
+    os.makedirs(base, exist_ok=True)
+    payload = {
+        "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "device": dev.device_kind, "platform": dev.platform,
+        "on_tpu": on_tpu, "cases": out,
+        "all_ok": all(r.get("ok") for r in out),
+    }
+    fname = "kernel_reval_smoke.json" if smoke else "kernel_reval_r5.json"
+    with open(os.path.join(base, fname), "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps({"kernel_reval_all_ok": payload["all_ok"]}))
+
+
+if __name__ == "__main__":
+    main()
