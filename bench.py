@@ -218,6 +218,15 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         )
         try:
             try:
+                # Pin the platform BEFORE jax imports: with JAX_PLATFORMS
+                # unset, a fast-failing plugin lets jax fall back to CPU
+                # silently AND the want_tpu guard below reads the empty env
+                # as "cpu is fine" — the two must agree so a CPU fallback can
+                # never emit a success-shaped metric line (ADVICE r4).
+                # platform is "tpu" or None here ("cpu" returned early above),
+                # and the axon plugin is this image's TPU backend.
+                os.environ.setdefault("JAX_PLATFORMS", "axon")
+
                 import jax
                 import jax.numpy as jnp
 

@@ -180,6 +180,54 @@ def validate_config(cfg: ConfigDict) -> None:
     if gbs is not None and mbs is not None and int(gbs) % int(mbs) != 0:
         raise ValueError(f"global_batch_size {gbs} not divisible by micro_batch_size {mbs}")
 
+    # ---- pipeline schedule ------------------------------------------------
+    # distributed_strategy.pipeline.schedule: auto | 1f1b | wavefront.  The
+    # full model-aware gate is parallel.pipeline.supports_1f1b (resolved at
+    # trainer build); the config-shape constraints die here with curated
+    # messages.
+    pipe_knobs = dict(ds.get("pipeline", {}) or {})
+    if pipe_knobs:
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            PIPELINE_SCHEDULES,
+            blocked_1f1b_reason,
+        )
+
+        unknown = set(pipe_knobs) - {"schedule"}
+        if unknown:
+            raise ValueError(
+                f"unknown distributed_strategy.pipeline keys {sorted(unknown)}; "
+                f"supported: schedule ({'/'.join(PIPELINE_SCHEDULES)})"
+            )
+        sched_knob = str(pipe_knobs.get("schedule", "auto")).lower()
+        if sched_knob not in PIPELINE_SCHEDULES:
+            raise ValueError(
+                f"pipeline.schedule must be one of "
+                f"{'/'.join(PIPELINE_SCHEDULES)}, got {sched_knob!r}"
+            )
+        if sched_knob == "1f1b":
+            # same catalog the trainer-build gate uses (supports_1f1b); the
+            # model-FAMILY constraints need the built model config and fire
+            # at resolve_schedule instead
+            from neuronx_distributed_training_tpu.data.build import (
+                alignment_strategy,
+            )
+
+            try:
+                alignment, _ = alignment_strategy(cfg)
+            except ValueError:
+                # malformed alignment block: the alignment catalog below
+                # rejects it with its own curated message
+                alignment = None
+            blocked = blocked_1f1b_reason({
+                "pipeline_model_parallel_size": pp,
+                "virtual_pipeline_model_parallel_size": int(vp),
+                "context_parallel_size": cp,
+                "alignment": alignment,
+                "lora": bool(dict(model.get("lora", {}) or {})),
+            })
+            if blocked is not None:
+                raise ValueError(f"pipeline.schedule: 1f1b: {blocked}")
+
     # ---- MoE --------------------------------------------------------------
     moe = model.get("moe", {}) or {}
     if moe.get("dropless") and (moe.get("capacity_factor") or 0) > 0:

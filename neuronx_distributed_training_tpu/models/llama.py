@@ -490,6 +490,51 @@ def pipeline_hooks(cfg: LlamaConfig, policy: DtypePolicy, *, shift_labels: bool 
     return embed_fn, stage_fn, loss_fn
 
 
+def onef1b_head_hooks(cfg: LlamaConfig, policy: DtypePolicy):
+    """Head wiring for ``parallel.pipeline.pipeline_loss_and_grad`` (1F1B).
+
+    Returns ``(head_hidden_fn, head_params_of, head_weight_of, fold_grads)``:
+    the hidden hook (final RMS norm), extractors for the head-param subtree
+    and the [V, H] head matrix (tied embed or transposed ``lm_head.w`` —
+    matching ``logits_fn``), and the folder that merges the 1F1B grad entries
+    ``head_params``/``head_weight`` back into a params-shaped grad tree.
+    Shared by the mixtral family (same top-level param layout, ``cfg.llama``).
+    """
+    tied = cfg.tie_word_embeddings
+
+    def head_hidden_fn(hp, y):
+        return norm_ops.apply_rms_norm(hp["final_norm"], y, eps=cfg.rms_norm_eps)
+
+    def head_params_of(params):
+        return {"final_norm": params["final_norm"]}
+
+    def head_weight_of(params):
+        w = (params["embed"]["embedding"] if tied else params["lm_head"]["w"].T)
+        return w.astype(policy.compute_dtype)
+
+    def fold_grads(grads, d_head_params, d_head_weight):
+        grads = dict(grads)
+        grads["final_norm"] = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype),
+            grads["final_norm"], d_head_params["final_norm"],
+        )
+        if tied:
+            emb = grads["embed"]["embedding"]
+            grads["embed"] = {
+                **grads["embed"],
+                "embedding": emb + d_head_weight.astype(emb.dtype),
+            }
+        else:
+            w = grads["lm_head"]["w"]
+            grads["lm_head"] = {
+                **grads["lm_head"],
+                "w": w + d_head_weight.T.astype(w.dtype),
+            }
+        return grads
+
+    return head_hidden_fn, head_params_of, head_weight_of, fold_grads
+
+
 def forward(
     params,
     batch: dict[str, jax.Array],

@@ -269,6 +269,12 @@ def pipeline_hooks(cfg: MixtralConfig, policy: DtypePolicy, *,
     return embed_fn, stage_fn, loss_fn
 
 
+def onef1b_head_hooks(cfg: MixtralConfig, policy: DtypePolicy):
+    """1F1B head wiring — identical top-level param layout to llama
+    (embed / final_norm / optional lm_head), so delegate."""
+    return llama.onef1b_head_hooks(cfg.llama, policy)
+
+
 def forward(
     params,
     batch: dict[str, jax.Array],

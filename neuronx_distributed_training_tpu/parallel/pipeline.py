@@ -198,7 +198,7 @@ def pipeline_loss(
         stage_aux=stage_aux,
     )
     layer_spec = P(None, PIPE_AXIS) if vp > 1 else P(PIPE_AXIS)
-    fn = jax.shard_map(
+    fn = shd.shard_map(
         body,
         mesh=mesh,
         # manual over pipe only: layers sharded on their pipe dim,
@@ -207,11 +207,17 @@ def pipeline_loss(
         # pipe-sharded on dim 0.  (params themselves are not an operand —
         # the embed and loss hooks, the only consumers, run outside.)
         in_specs=(layer_spec, P(), P(PIPE_AXIS)),
-        out_specs=(P(PIPE_AXIS), P()),
+        # aux comes back as a [pp] pipe-tiled vector summed OUTSIDE the manual
+        # region (not an in-body psum + replicated-scalar out): the replicated
+        # scalar's transpose trips legacy shard_map's spec check when a
+        # nonzero aux cotangent flows (MoE router loss under jax.grad), while
+        # the tiled sum transposes cleanly on every jax version
+        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
-    parked, aux_total = fn(layer_params, microbatches, emb)
+    parked, aux_ranks = fn(layer_params, microbatches, emb)
+    aux_total = jnp.sum(aux_ranks)
 
     # ---- head + CE, once, outside the manual region --------------------
     # parked row g holds microbatch m_of_g's last-stage output (same layout
@@ -263,10 +269,12 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
     path and the collective-permute inside is safe (a RANK-dependent gate
     would deadlock: GSPMD collectives need every device at the rendezvous).
 
-    Returns ``(parked, aux_sum)``: ``parked [slots, mb, s, h]`` holds the
+    Returns ``(parked, aux)``: ``parked [slots, mb, s, h]`` holds the
     final-chunk outputs of the microbatches this rank parks (same layout as
     ``emb``) — the caller computes the loss over them outside the manual
-    region — and ``aux_sum`` is the psum'd MoE router aux.
+    region — and ``aux [1]`` is this rank's MoE router-aux contribution (the
+    caller sums the pipe-tiled vector; summing outside instead of an in-body
+    psum keeps the backward legal on legacy shard_map).
     """
     rank = jax.lax.axis_index(PIPE_AXIS)
     is_first = rank == 0
@@ -396,8 +404,7 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
         (zeros, circ0, park0, jnp.zeros((), jnp.float32)),
         jnp.arange(nm * vp + pp - 1),
     )
-    aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
-    return park, aux_total
+    return park, aux_acc[None]
 
 
 # ---------------------------------------------------------------------------
@@ -436,6 +443,122 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
 # Scope: vp == 1, plain matmul head (tied embed or lm_head.w), token-level CE
 # (pretrain/SFT).  vp > 1, preference alignment, and exotic heads keep the
 # autodiff wavefront — ``supports_1f1b`` is the gate.
+
+
+PIPELINE_SCHEDULES = ("auto", "1f1b", "wavefront")
+
+
+def blocked_1f1b_reason(parallel_cfg: dict) -> Optional[str]:
+    """Config-SHAPE constraints on the 1F1B schedule (no model object needed).
+
+    The single source of truth shared by ``supports_1f1b`` (trainer build)
+    and ``config.loader.validate_config`` (load time) — one wording, one
+    catalog, whichever layer fires first.  Returns the blocking reason, or
+    None when the shape qualifies (the model-family checks in
+    ``supports_1f1b`` still apply).
+    """
+    pp = int(parallel_cfg.get("pipeline_model_parallel_size", 1) or 1)
+    vp = int(parallel_cfg.get("virtual_pipeline_model_parallel_size", 1) or 1)
+    cp = int(parallel_cfg.get("context_parallel_size", 1) or 1)
+    alignment = parallel_cfg.get("alignment")
+    if pp <= 1:
+        return "1f1b requires pipeline_model_parallel_size > 1"
+    if vp > 1:
+        return (
+            "the interleaved virtual pipeline "
+            "(virtual_pipeline_model_parallel_size > 1) runs only under the "
+            "autodiff wavefront schedule"
+        )
+    if cp > 1:
+        return (
+            "context parallelism under pp is proven for the autodiff "
+            "wavefront only (blockwise attention vjp inside the manual 1f1b "
+            "tick loop is unvalidated); use schedule: wavefront for pp x cp"
+        )
+    if alignment in ("dpo", "orpo", "kto"):
+        return (
+            f"preference alignment ({alignment}) pipelines via the "
+            f"concatenated-forward wavefront; 1f1b implements token-level CE "
+            f"only"
+        )
+    if parallel_cfg.get("lora"):
+        return (
+            "LoRA adapters are not wired for the manual-vjp 1f1b head "
+            "(adapter grads on lm_head would be silently dropped)"
+        )
+    return None
+
+
+def supports_1f1b(model_cfg: Any, parallel_cfg: dict) -> tuple[bool, str]:
+    """Can the manual-vjp 1F1B schedule run this model/parallelism combo?
+
+    Returns ``(ok, reason)``; ``reason`` explains the first blocking
+    constraint when ``ok`` is False (and is the message ``resolve_schedule``
+    raises when the config FORCES ``1f1b``).
+
+    ``parallel_cfg`` mirrors the ``distributed_strategy`` block plus trainer
+    context: ``pipeline_model_parallel_size``,
+    ``virtual_pipeline_model_parallel_size``, ``context_parallel_size``,
+    ``alignment`` (None/"sft" or a preference strategy), ``lora`` (bool).
+    The model side requires the plain-matmul-head token-CE structure the
+    in-loop vocab-sharded head implements: llama/mistral qualifies today.
+    Mixtral's head/aux wiring exists but its dropless-MoE stage vjp is gated
+    out (backend-dependent numerics — see the branch below), and
+    megatron-GPT (learned positions, dropout threading,
+    post_ln/normformer/gpt_j head variants) keeps the autodiff wavefront
+    until its head is wired.
+    """
+    blocked = blocked_1f1b_reason(parallel_cfg)
+    if blocked is not None:
+        return False, blocked
+    if getattr(model_cfg, "attention_impl", "") == "zigzag_ring":
+        return False, "zigzag_ring attention is not supported under pp at all"
+    from neuronx_distributed_training_tpu.models import llama as _llama
+
+    if isinstance(model_cfg, _llama.LlamaConfig):
+        return True, "llama/mistral: plain matmul head + token CE"
+    from neuronx_distributed_training_tpu.models import mixtral as _mixtral
+
+    if isinstance(model_cfg, _mixtral.MixtralConfig):
+        # The head/aux wiring exists (mixtral.onef1b_head_hooks), but the
+        # sort-based dropless-MoE stage vjp is numerically corrupted when
+        # linearized at a scan-carry-derived activation inside the legacy
+        # fully-manual shard_map fallback (loss exact, stage grads off by a
+        # few percent; bisected tick-by-tick — dense llama stages are exact
+        # under the identical schedule).  Until the toolchain's shard_map
+        # supports partial-auto natively, mixtral keeps the wavefront.
+        return False, (
+            "mixtral: dropless-MoE stage vjp has backend-dependent numerics "
+            "under the 1f1b tick loop (dense families only for now)"
+        )
+    return False, (
+        f"{type(model_cfg).__name__}: head not wired for the manual-vjp "
+        f"1f1b schedule (supported families: llama/mistral)"
+    )
+
+
+def resolve_schedule(schedule: str, model_cfg: Any, parallel_cfg: dict) -> str:
+    """``pipeline.schedule`` knob -> concrete schedule ("1f1b"/"wavefront").
+
+    ``auto`` picks 1f1b whenever ``supports_1f1b`` allows (the memory-bounded
+    production path: O(pp) in-flight activations instead of the wavefront's
+    O(nm + pp) autodiff residuals); forcing ``1f1b`` on an unsupported combo
+    raises with the gate's reason instead of failing deep inside shard_map.
+    """
+    schedule = str(schedule or "auto").lower()
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"pipeline.schedule must be one of {'/'.join(PIPELINE_SCHEDULES)}, "
+            f"got {schedule!r}"
+        )
+    if schedule == "wavefront":
+        return "wavefront"
+    ok, reason = supports_1f1b(model_cfg, parallel_cfg)
+    if schedule == "1f1b":
+        if not ok:
+            raise ValueError(f"pipeline.schedule: 1f1b is unsupported here: {reason}")
+        return "1f1b"
+    return "1f1b" if ok else "wavefront"
 
 
 def _tree_index(tree, i):
@@ -479,16 +602,20 @@ def pipeline_loss_and_grad(
     ignore_index: int = -100,
 ):
     """1F1B pipeline step: returns ``(loss, grads)`` where ``grads`` has
-    entries ``{"layers", "embed_cotangent", "head_params", "head_weight"}``:
+    exactly the keys ``{"layers", "params_from_embed", "head_params",
+    "head_weight"}`` (a tested invariant — tests/test_pipeline_1f1b.py):
 
     - ``layers``: [L, ...] tree, pipe-sharded like ``layer_params``;
-    - ``embed_cotangent``: cotangent of the PERMUTED embed feed
-      ``vmap(embed_fn)(mb_perm)`` (same [pp*slots, mb, s, h] layout /
-      pipe sharding as the feed) — pull it through ``jax.vjp`` of the embed
-      computation to get embedding-table grads;
+    - ``params_from_embed``: a PARAMS-shaped tree — the parked cotangent of
+      the permuted embed feed has already been pulled through ``jax.vjp`` of
+      the embed computation internally, so its ``embed`` entries hold the
+      embedding-table grads and every leaf the embed hook does not touch is
+      zero.  Add the other grad entries onto it to assemble the full grad
+      pytree;
     - ``head_params``: grads of ``head_hidden_fn``'s params (final norm);
-    - ``head_weight``: [V, H] grad of the head matmul (add to the embed table
-      grad when tied).
+    - ``head_weight``: [V, H] grad of the head matmul (transpose into
+      ``lm_head.w`` for an untied [H, V] head; add to the embed-table grad
+      when tied).
 
     Loss matches ``pipeline_loss`` (same masking and normalization); the
     caller divides nothing — normalization by the global valid-token count is
@@ -530,7 +657,7 @@ def pipeline_loss_and_grad(
     )
     layer_spec = P(PIPE_AXIS)
     vocab_spec = P(PIPE_AXIS, *([None] * (head_weight.ndim - 1)))
-    fn = jax.shard_map(
+    fn = shd.shard_map(
         body,
         mesh=mesh,
         in_specs=(layer_spec, P(), P(), vocab_spec, P(PIPE_AXIS), P()),

@@ -261,7 +261,14 @@ def in_manual_region() -> bool:
     exact).  CP attention therefore must NOT open an inner shard_map there —
     callers switch to the pure-GSPMD blockwise body instead.
     """
-    cur = jax.sharding.get_abstract_mesh()
+    if shd.manual_fallback_active():
+        # legacy-jax fully-manual fallback (shd.shard_map): no abstract-mesh
+        # query exists there, the thread-local flag IS the signal
+        return True
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract_mesh is None:
+        return False  # legacy jax outside the fallback: no manual context
+    cur = get_abstract_mesh()
     return bool(getattr(cur, "axis_names", None)
                 and any("Manual" in str(t) for t in cur.axis_types))
 
@@ -438,7 +445,7 @@ def ring_attention(
     if attention_mask is not None:
         extra_specs = (P(DATA_AXES, "context"),)
         extra_args = (attention_mask.astype(jnp.int32),)
-    fn = jax.shard_map(
+    fn = shd.shard_map(
         body,
         mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec) + extra_specs,
@@ -640,7 +647,7 @@ def zigzag_ring_attention(
     hc = s // (2 * cp)
     use_flash = flash_tileable(hc, hc, d, max(h_l, 1), max(kvh_l, 1))
 
-    fn = jax.shard_map(
+    fn = shd.shard_map(
         functools.partial(_zigzag_local, axis_name=axis_name, cp=cp,
                           use_flash=use_flash),
         mesh=mesh,

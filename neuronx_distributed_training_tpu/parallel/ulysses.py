@@ -160,7 +160,7 @@ def ulysses_attention(
     if attention_mask is not None:
         extra_specs = (P(DATA_AXES, "context"),)
         extra_args = (attention_mask.astype(jnp.int32),)
-    fn = jax.shard_map(
+    fn = shd.shard_map(
         body,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec) + extra_specs,

@@ -149,6 +149,7 @@ class Trainer:
     step: int = 0
     pre_fit: Optional[Callable] = None  # runs once before the loop (DPO ref pass)
     ema_cfg: Optional[Any] = None  # optim.adamw.EMAConfig when EMA is enabled
+    pipeline_schedule: Optional[str] = None  # "1f1b"/"wavefront" under pp, else None
 
     # -- assembly -----------------------------------------------------------
 
@@ -261,6 +262,8 @@ class Trainer:
 
             from neuronx_distributed_training_tpu.parallel.pipeline import (
                 pipeline_loss,
+                pipeline_loss_and_grad,
+                resolve_schedule,
                 stage_layer_slice,
                 to_interleaved,
             )
@@ -384,6 +387,31 @@ class Trainer:
             aux_scale = float(hook_opts.get("aux_inv_layers", 0.0)) / nm
             needs_rng = bool(hook_opts.get("needs_rng"))
 
+            # schedule selection: the memory-bounded manual-vjp 1F1B is the
+            # production default whenever the model/loss combination supports
+            # it (reference run_train's 1F1B engine, base.py:374-383 — O(pp)
+            # in-flight activations instead of the autodiff wavefront's
+            # O(nm + pp) per-tick residuals); `pipeline.schedule` in the
+            # distributed_strategy block forces either schedule explicitly
+            pipe_knobs = dict(
+                (cfg.get("distributed_strategy", {}) or {}).get("pipeline", {})
+                or {}
+            )
+            pp_schedule = resolve_schedule(
+                pipe_knobs.get("schedule", "auto"), model_cfg,
+                {
+                    "pipeline_model_parallel_size": pp,
+                    "virtual_pipeline_model_parallel_size": vp,
+                    "context_parallel_size": int(
+                        mesh_cfg.context_parallel_size or 1),
+                    "alignment": (alignment
+                                  if alignment in ("dpo", "orpo", "kto")
+                                  else None),
+                    "lora": bool(lora_block),
+                },
+            )
+            logger.info("pipeline schedule: %s (pp=%d, vp=%d)", pp_schedule, pp, vp)
+
             def loss_fn(p, batch, key):  # noqa: F811 — pipelined replacement
                 mbs = microbatch_split(batch, nm)
                 if needs_rng and key is not None:
@@ -412,6 +440,54 @@ class Trainer:
                         f"the pipelined eval loss microbatches the same way"
                     )
             eval_loss_fn = loss_fn
+
+            if pp_schedule == "1f1b":
+                # train-step grads come from the manual-vjp 1F1B ring; eval
+                # keeps the autodiff wavefront loss above (it only needs the
+                # forward value).  Family head dispatch: the gate currently
+                # admits llama/mistral only, but route by config type so
+                # re-admitting mixtral (its onef1b_head_hooks are already
+                # wired) needs nothing beyond flipping supports_1f1b.
+                from neuronx_distributed_training_tpu.models import (
+                    mixtral as _mixtral_m,
+                )
+
+                if isinstance(model_cfg, _mixtral_m.MixtralConfig):
+                    head_hooks = _mixtral_m.onef1b_head_hooks(model_cfg, policy)
+                else:
+                    head_hooks = llama.onef1b_head_hooks(model_cfg, policy)
+                (head_hidden_fn, head_params_of, head_weight_of,
+                 fold_head_grads) = head_hooks
+
+                def pp_loss_and_grad(p, batch, key):
+                    mbs = microbatch_split(batch, nm)
+                    if needs_rng and key is not None:
+                        mbs = dict(mbs)
+                        mbs["_rng"] = jax.random.split(key, nm)
+                    loss, g = pipeline_loss_and_grad(
+                        p, p["layers"], mbs,
+                        embed_fn=embed_fn, stage_fn=stage_fn,
+                        head_hidden_fn=head_hidden_fn,
+                        head_params=head_params_of(p),
+                        head_weight=head_weight_of(p),
+                        mesh=mesh, num_microbatches=nm,
+                        stage_aux=stage_aux, aux_scale=aux_scale,
+                        shift_labels=shift_labels,
+                    )
+                    # assemble the params-shaped grad tree: start from the
+                    # embed-path cotangent (zeros off the embed path), add
+                    # the layer-stack grads, fold the head grads back in
+                    grads = dict(g["params_from_embed"])
+                    grads["layers"] = jax.tree_util.tree_map(
+                        lambda a, d: a + d.astype(a.dtype),
+                        grads["layers"], g["layers"],
+                    )
+                    grads = fold_head_grads(
+                        grads, g["head_params"], g["head_weight"]
+                    )
+                    return loss, {}, grads
+            else:
+                pp_loss_and_grad = None
             pspecs = specs_fn(pipeline=True)
             if vp > 1:
                 flat_builder = param_builder
@@ -427,6 +503,8 @@ class Trainer:
                 )
             num_micro_in_step = 1
         else:
+            pp_schedule = None
+            pp_loss_and_grad = None
             pspecs = specs_fn()
         opt_block = dict((cfg.get("model", {}) or {}).get("optim", {}) or {})
         opt_cfg = AdamWConfig.from_config(opt_block, cfg.get("trainer", {}))
@@ -464,6 +542,7 @@ class Trainer:
             trainable_mask=trainable,
             ema_cfg=ema_cfg,
             param_specs=pspecs,
+            loss_and_grad_fn=pp_loss_and_grad,
         )
         # NARROWED EMA workaround (round 3): donating an opt state that
         # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)
@@ -718,6 +797,7 @@ class Trainer:
             train_step=jstep, eval_step=eval_fn, data_module=data_module,
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
             max_steps=max_steps, pre_fit=pre_fit, ema_cfg=ema_cfg,
+            pipeline_schedule=pp_schedule,
         )
 
     # -- resume -------------------------------------------------------------

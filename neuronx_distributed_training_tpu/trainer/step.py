@@ -57,9 +57,17 @@ def make_train_step(
     trainable_mask: Any = None,  # peft.lora.trainable_mask for LoRA freeze
     ema_cfg: Any = None,  # optim.adamw.EMAConfig; state must carry an "ema" tree
     param_specs: Any = None,  # pin grads to the param sharding (see below)
+    loss_and_grad_fn: Optional[Callable] = None,  # manual-grad schedules (1F1B)
 ) -> Callable:
     """Build the (un-jitted) train step:
-    ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``."""
+    ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``.
+
+    ``loss_and_grad_fn`` — ``(params, batch, step_key) -> (loss, aux, grads)``
+    — replaces the ``jax.value_and_grad`` of ``loss_fn`` when a schedule
+    computes its own gradients (the manual-vjp 1F1B pipeline).  Everything
+    downstream of the gradients — grad-accum dtype, the param-sharding pin,
+    the AdamW/ZeRO-1 update, metrics — is the SAME code path, so the
+    optimizer boundary is schedule-independent."""
 
     def grad_one_microbatch(params, mb, step_key):
         def scalar_loss(p):
@@ -77,7 +85,13 @@ def make_train_step(
         return jax.value_and_grad(scalar_loss, has_aux=True)(params)
 
     def train_step(params, opt_state, batch, step_key):
-        if num_microbatches == 1:
+        if loss_and_grad_fn is not None:
+            loss, aux, grads = loss_and_grad_fn(params, batch, step_key)
+            loss = loss.astype(jnp.float32)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(policy.grad_accum_dtype), grads
+            )
+        elif num_microbatches == 1:
             (loss, aux), grads = grad_one_microbatch(params, batch, step_key)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(policy.grad_accum_dtype), grads

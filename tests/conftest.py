@@ -36,6 +36,29 @@ def cpu_mesh(devices8):
     return build_mesh(MeshConfig(tensor_model_parallel_size=2), devices=devices8)
 
 
+def lower_in_mesh(mesh, fn, *args):
+    """Lower + compile ``fn(*args)`` INSIDE ``mesh``'s context — the shared
+    guard for every test that inspects a compiled train/loss graph.
+
+    Lowering outside ``with mesh, shd.use_mesh(mesh)`` silently drops every
+    ``shd.constrain`` in the traced program (constrain no-ops without an
+    active mesh), so a FLOPs/memory gate would pin a graph WITHOUT the
+    sharding constraints it claims to measure (round-4 advisor finding on
+    tests/test_pp_flops_parity.py).  The assert makes that mistake loud."""
+    import jax as _jax
+
+    from neuronx_distributed_training_tpu.parallel import sharding as shd
+
+    with mesh, shd.use_mesh(mesh):
+        assert shd.active_mesh() is mesh, (
+            "lower_in_mesh: no active mesh at lower time — shd.constrain "
+            "would silently no-op in the compiled graph"
+        )
+        lowered = (fn.lower(*args) if hasattr(fn, "lower")
+                   else _jax.jit(fn).lower(*args))
+        return lowered.compile()
+
+
 def ragged_right_pad_mask(b, s, valid_lens):
     """[b, s] int32 attention_mask with row i real for its first valid_lens[i]
     positions (the HF right-padding convention) — shared by the masked

@@ -300,3 +300,57 @@ class TestValidationCatalog:
                "model.num_layers": 4,
                "data.seq_length": 2048}))
 
+
+
+class TestPipelineScheduleKnob:
+    """distributed_strategy.pipeline.schedule validation (the 1F1B knob)."""
+
+    _base = TestValidationCatalog._base
+    _expect = TestValidationCatalog._expect
+
+    def test_unknown_schedule_rejected(self):
+        self._expect("pipeline.schedule",
+                     **{"distributed_strategy.pipeline.schedule": "gpipe",
+                        "distributed_strategy.pipeline_model_parallel_size": 2})
+
+    def test_unknown_pipeline_key_rejected(self):
+        self._expect("unknown distributed_strategy.pipeline keys",
+                     **{"distributed_strategy.pipeline.shedule": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2})
+
+    def test_1f1b_requires_pp(self):
+        self._expect("requires",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b"})
+
+    def test_1f1b_rejects_vp(self):
+        self._expect("virtual",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "distributed_strategy.virtual_pipeline_model_parallel_size": 2,
+                        "model.num_layers": 4})
+
+    def test_1f1b_rejects_cp(self):
+        self._expect("context parallelism",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "distributed_strategy.context_parallel_size": 2,
+                        "model.fusions.ring_attention": True,
+                        "data.seq_length": 1024})
+
+    def test_1f1b_rejects_preference_alignment(self):
+        self._expect("token-level CE",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "model_alignment_strategy": "dpo"})
+
+    def test_1f1b_rejects_lora(self):
+        self._expect("LoRA",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "model.lora.r": 8})
+
+    def test_valid_schedules_load(self):
+        for sched in ("auto", "1f1b", "wavefront"):
+            load_config(self._base(
+                **{"distributed_strategy.pipeline.schedule": sched,
+                   "distributed_strategy.pipeline_model_parallel_size": 2}))

@@ -130,9 +130,12 @@ class TestPipelineParity:
         """Regression guard on the wavefront's comm schedule: the FORWARD
         pipeline at pp=4/tp=1 compiles exactly 2*pp+1 collective-permutes
         (the ring hop, plus one instruction per switch branch for the
-        tick-uniform embed route and parked route) and no all-gathers — a
-        divergent-cond or reshard regression inside the body would change
-        these counts."""
+        tick-uniform embed route and parked route).  On new jax
+        (partial-auto shard_map) NO all-gather is permitted at all; on the
+        legacy fully-manual fallback exactly one is — the in-spec
+        re-replication of the pipe-sharded embed feed over the auto axes,
+        an inherent (documented) cost of that fallback, not a schedule
+        regression."""
         from neuronx_distributed_training_tpu.utils.debug import collective_counts
 
         params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
@@ -143,7 +146,8 @@ class TestPipelineParity:
             f = jax.jit(lambda p, m: pipe_loss(p, m, mesh))
             counts = collective_counts(f, sh_params, sh_mbs)
         assert counts["collective-permute"] == 2 * 4 + 1, counts
-        assert counts["all-gather"] == 0, counts
+        gather_budget = 0 if hasattr(jax, "shard_map") else 1
+        assert counts["all-gather"] <= gather_budget, counts
 
     def test_pp1_fallback_matches(self):
         params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)

@@ -60,11 +60,16 @@ def _cfg(pp: int) -> dict:
 
 
 def _compiled_flops(pp: int) -> float:
+    from tests.conftest import lower_in_mesh
+
     t = Trainer.from_config(load_config(_cfg(pp)), enable_checkpointing=False)
     batch = next(t.data_module.sharded_batches(t.mesh))
-    compiled = t.train_step.lower(
-        t.params, t.opt_state, batch, jax.random.PRNGKey(0)
-    ).compile()
+    # lower INSIDE the mesh context (shared guard helper): outside it every
+    # shd.constrain in the step no-ops and the gate pins an unconstrained
+    # graph — NOT the round-4 grad-sharding graph it exists to protect
+    compiled = lower_in_mesh(
+        t.mesh, t.train_step, t.params, t.opt_state, batch, jax.random.PRNGKey(0)
+    )
     ca = compiled.cost_analysis()
     ca = ca[0] if isinstance(ca, (list, tuple)) else ca
     return float(ca.get("flops", -1.0))

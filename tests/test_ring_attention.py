@@ -306,7 +306,7 @@ def test_cp_attention_pipe_varying_grads(devices8):
             y = ring_attention(x, x, x, causal=True)
             return jax.lax.psum(jnp.sum(jnp.square(y)), "pipe")
 
-        f = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+        f = shd.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
                           axis_names={"pipe"}, check_vma=False)
         return f(q)
 
