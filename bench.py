@@ -394,12 +394,25 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         batch = jax.device_put(batch, ns(P(("data", "expert"))))
         key = jax.random.PRNGKey(2)
 
+        # AOT compile first so the bench reports the trainer's telemetry
+        # schema (compile_seconds + collective/memory census) and the timed
+        # loop runs the very executable that was measured — zero extra
+        # compiles (telemetry.census, same flow as Trainer._compile_census).
+        from neuronx_distributed_training_tpu.telemetry import compile_census
+
         t_compile = time.perf_counter()
+        compiled = jstep.lower(params, opt_state, batch, key).compile()
+        compile_seconds = time.perf_counter() - t_compile
+        census = compile_census(compiled, compile_seconds=compile_seconds)
+        log(f"bench: compiled in {compile_seconds:.1f}s "
+            f"collectives={census.get('collectives')}")
+
+        t_warm = time.perf_counter()
         for _ in range(warmup):
-            params, opt_state, metrics = jstep(params, opt_state, batch, key)
+            params, opt_state, metrics = compiled(params, opt_state, batch, key)
         # A host scalar fetch is the only reliable execution fence on remote
         # (tunnelled) TPU backends — block_until_ready alone doesn't flush.
-        log(f"bench: warmup done in {time.perf_counter() - t_compile:.1f}s "
+        log(f"bench: warmup done in {time.perf_counter() - t_warm:.1f}s "
             f"loss={float(metrics['loss']):.4f}")
 
         # Measure fetch round-trip on settled buffers: min of several samples so
@@ -413,7 +426,7 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         rtt = min(rtts)
         t0 = time.perf_counter()
         for _ in range(steps):
-            params, opt_state, metrics = jstep(params, opt_state, batch, key)
+            params, opt_state, metrics = compiled(params, opt_state, batch, key)
         _ = float(metrics["loss"])  # fence: forces the whole dependent chain
         elapsed = time.perf_counter() - t0
         # the rtt correction must stay a correction — never let it swallow the
@@ -435,6 +448,11 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         "mfu": mfu,
         "peak_tflops": peak,
         "num_layers": cfg.num_layers,
+        # trainer-telemetry-schema fields (run_summary.json parity) so the
+        # BENCH_*.json trajectory is comparable with training runs
+        "compile_seconds": round(compile_seconds, 2),
+        "collectives": census.get("collectives"),
+        "memory_analysis": census.get("memory_analysis"),
     }
 
 
@@ -602,6 +620,13 @@ def main() -> None:
         "num_layers": r["num_layers"],
         "tied_embeddings": r.get("tied_embeddings", tied),
         "seq_len": seq,
+        # the trainer's telemetry schema (metrics.jsonl / run_summary.json
+        # key names): mfu as a FRACTION alongside the percent headline, plus
+        # the headline regime's compile census
+        "mfu": round(r["mfu"], 6),
+        "compile_seconds": r.get("compile_seconds"),
+        "collectives": r.get("collectives"),
+        "memory_analysis": r.get("memory_analysis"),
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }

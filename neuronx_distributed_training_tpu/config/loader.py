@@ -339,6 +339,16 @@ def validate_config(cfg: ConfigDict) -> None:
             f"mixed_precision, bf16SR, autocast, fp32, manual"
         )
 
+    # ---- exp_manager.telemetry -------------------------------------------
+    # the unified step-telemetry knob block (spans/mfu/compile_census/
+    # device_memory/goodput); a typo'd knob must die here, not silently run
+    # with defaults
+    em = cfg.get("exp_manager", {}) or {}
+    if isinstance(em, Mapping) and "telemetry" in em:
+        from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+
+        TelemetryConfig.from_config(em.get("telemetry"))
+
     # ---- model alignment --------------------------------------------------
     # root-level key (reference hf_llama3_8B_DPO_config.yaml:7); accepts a
     # bare string ("dpo") or a one-key block ({dpo: {beta: ...}})

@@ -1,0 +1,34 @@
+"""Unified step telemetry: span timing, MFU, compile census, goodput.
+
+The observable surface the reference ships piecemeal (NeMo ``TimingCallback``,
+``llama_perf_estimate.py``, profiler hooks) as ONE subsystem the trainer
+threads through every sink: per-step span decomposition (``spans``), a
+first-compile memory/collective/FLOPs census persisted to ``run_summary.json``
+(``census``), retrace detection (``recompile``), and the ``exp_manager:
+telemetry:`` knob block that gates it all (``config``).  Everything here is
+host-side bookkeeping — no device syncs between logging boundaries.
+"""
+
+from neuronx_distributed_training_tpu.telemetry.census import (
+    compile_census,
+    memory_analysis_bytes,
+)
+from neuronx_distributed_training_tpu.telemetry.config import (
+    TELEMETRY_KNOBS,
+    TelemetryConfig,
+)
+from neuronx_distributed_training_tpu.telemetry.recompile import RecompileDetector
+from neuronx_distributed_training_tpu.telemetry.spans import (
+    NON_PRODUCTIVE_SPANS,
+    SpanTimer,
+)
+
+__all__ = [
+    "NON_PRODUCTIVE_SPANS",
+    "RecompileDetector",
+    "SpanTimer",
+    "TELEMETRY_KNOBS",
+    "TelemetryConfig",
+    "compile_census",
+    "memory_analysis_bytes",
+]

@@ -1,0 +1,94 @@
+"""First-compile census: memory analysis, HLO collective counts, FLOPs model.
+
+The one moment the whole compiled program is in hand — right after the train
+step's first (and, in a healthy run, only) compile — is the cheapest place to
+record everything static about the run: XLA's own memory accounting, the
+collective census (the communication pattern GSPMD actually inserted, the
+quantity DeepCompile-style profiling reasons about), and the analytic FLOPs
+estimate MFU is computed against.  ``compile_census`` harvests all of it from
+an AOT-``compile()``d step with zero extra compiles; the trainer persists the
+result to ``run_summary.json`` next to ``metrics.jsonl``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_MEMORY_FIELDS = (
+    "temp_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def memory_analysis_bytes(compiled: Any) -> Optional[dict[str, int]]:
+    """``compiled.memory_analysis()`` -> plain dict (None when the backend
+    doesn't implement it).  ``peak_bytes`` is the classic static estimate
+    arguments + outputs + temporaries — what the program needs resident at
+    once, ignoring donation overlap (aliased bytes are reported separately so
+    readers can subtract them)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 — census must never fail the run
+        logger.debug("memory_analysis unavailable: %s", e)
+        return None
+    if ma is None:
+        return None
+    out: dict[str, int] = {}
+    for field in _MEMORY_FIELDS:
+        val = getattr(ma, field, None)
+        if val is not None:
+            out[field] = int(val)
+    if not out:
+        return None
+    out["peak_bytes"] = (
+        out.get("argument_size_in_bytes", 0)
+        + out.get("output_size_in_bytes", 0)
+        + out.get("temp_size_in_bytes", 0)
+    )
+    return out
+
+
+def compile_census(
+    compiled: Any,
+    *,
+    compile_seconds: Optional[float] = None,
+    flops_per_token: Optional[float] = None,
+    extra: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    """Everything static about a compiled train step, JSON-ready.
+
+    ``flops_per_token`` is the analytic FORWARD estimate (``utils.perf``);
+    the train-step figure (fwd + 2x bwd) is derived here so the file carries
+    both under their explicit names.
+    """
+    from neuronx_distributed_training_tpu.utils.debug import (
+        collective_counts_from_compiled,
+    )
+    from neuronx_distributed_training_tpu.utils.perf import (
+        train_step_flops_per_token,
+    )
+
+    census: dict[str, Any] = {}
+    if compile_seconds is not None:
+        census["compile_seconds"] = round(float(compile_seconds), 3)
+    try:
+        census["collectives"] = collective_counts_from_compiled(compiled)
+    except Exception as e:  # noqa: BLE001 — census must never fail the run
+        logger.warning("collective census unavailable: %s", e)
+    mem = memory_analysis_bytes(compiled)
+    if mem is not None:
+        census["memory_analysis"] = mem
+    if flops_per_token is not None:
+        census["fwd_flops_per_token"] = float(flops_per_token)
+        census["train_step_flops_per_token"] = train_step_flops_per_token(
+            float(flops_per_token)
+        )
+    if extra:
+        census.update(extra)
+    return census

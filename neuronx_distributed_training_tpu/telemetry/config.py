@@ -1,0 +1,83 @@
+"""``exp_manager.telemetry`` — the unified step-telemetry knob block.
+
+One frozen dataclass owns every on/off switch so the trainer, the exp
+manager, and the config validator all agree on the schema:
+
+.. code-block:: yaml
+
+    exp_manager:
+      telemetry:
+        spans: true           # host-side step decomposition + profiler annot.
+        mfu: true             # MFU + tokens/sec/chip from utils.perf
+        compile_census: true  # first-compile memory/collective/FLOPs census
+        device_memory: false  # per-boundary live HBM stats (memory_stats())
+        goodput: true         # cumulative productive-seconds accounting
+
+Everything defaults ON except ``device_memory`` (``memory_stats()`` is a
+backend query some runtimes answer slowly) — the layer is designed to be
+cheap enough to leave on: span timing is ``time.perf_counter`` bookkeeping,
+MFU is arithmetic on the already-maintained throughput window, and the census
+runs once at first compile.  None of the knobs adds a host sync between
+logging boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+#: knob name -> default; the single source of truth for schema validation
+TELEMETRY_KNOBS: dict[str, bool] = {
+    "spans": True,
+    "mfu": True,
+    "compile_census": True,
+    "device_memory": False,
+    "goodput": True,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    spans: bool = True
+    mfu: bool = True
+    compile_census: bool = True
+    device_memory: bool = False
+    goodput: bool = True
+
+    @classmethod
+    def from_config(cls, block: Any) -> "TelemetryConfig":
+        """Parse (and validate) an ``exp_manager.telemetry`` block.
+
+        Accepts ``None``/``{}`` (all defaults), a mapping of knob -> bool, or
+        a single bool (``telemetry: false`` switches the whole layer off).
+        Unknown keys and non-boolean values raise ``ValueError`` — a typo'd
+        knob must not silently run with defaults.
+        """
+        if block is None:
+            return cls()
+        if isinstance(block, bool):
+            return cls(**{k: block and v for k, v in TELEMETRY_KNOBS.items()}) \
+                if block else cls(**{k: False for k in TELEMETRY_KNOBS})
+        if not isinstance(block, Mapping):
+            raise ValueError(
+                f"exp_manager.telemetry must be a mapping of "
+                f"{sorted(TELEMETRY_KNOBS)} to booleans (or a single bool), "
+                f"got {type(block).__name__}"
+            )
+        unknown = set(block) - set(TELEMETRY_KNOBS)
+        if unknown:
+            raise ValueError(
+                f"unknown exp_manager.telemetry keys {sorted(unknown)}; "
+                f"supported: {sorted(TELEMETRY_KNOBS)}"
+            )
+        values: dict[str, bool] = {}
+        for k, v in block.items():
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"exp_manager.telemetry.{k} must be a boolean, got {v!r}"
+                )
+            values[k] = v
+        return cls(**values)
+
+    def to_dict(self) -> dict[str, bool]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,87 @@
+"""Recompile / retrace detection.
+
+A jitted function silently retraces whenever an argument's abstract shape,
+dtype, or tree structure changes — on TPU that is a multi-minute compile that
+looks like a hung step, and the classic trigger is a data loader yielding a
+ragged final batch.  ``RecompileDetector`` fingerprints the abstract
+signature of each named function's arguments on every call (pure host-side
+metadata: shapes and dtypes, never values — no device sync) and, when the
+signature changes mid-run, logs a warning naming exactly which leaves changed
+and how.
+
+This detects the CAUSE (a signature change) at dispatch time rather than the
+symptom (a stalled step) minutes later; when the trainer has swapped in an
+AOT-compiled step, the same check turns XLA's opaque "argument mismatch"
+error into a readable shape diff.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def _leaf_sig(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None:
+        return f"<{type(x).__name__}>"
+    return f"{dtype}[{','.join(str(d) for d in shape)}]"
+
+
+def _signature(args: tuple) -> dict[str, str]:
+    """{leaf path: "dtype[shape]"} over all positional args."""
+    out: dict[str, str] = {}
+    for i, arg in enumerate(args):
+        leaves = jax.tree_util.tree_flatten_with_path(arg)[0]
+        for path, leaf in leaves:
+            key = f"arg{i}" + "".join(str(p) for p in path)
+            out[key] = _leaf_sig(leaf)
+    return out
+
+
+class RecompileDetector:
+    """Warns (once per change) when a jitted fn's abstract arg signature
+    changes mid-run — the retrace-about-to-happen signal."""
+
+    def __init__(self) -> None:
+        self._seen: dict[str, dict[str, str]] = {}
+        self.events: list[str] = []
+
+    def check(self, name: str, *args: Any) -> bool:
+        """Record ``args``' signature under ``name``; returns True (and
+        warns with the offending diff) when it changed since the last call."""
+        sig = _signature(args)
+        prev = self._seen.get(name)
+        self._seen[name] = sig
+        if prev is None or prev == sig:
+            return False
+        diff = self.describe_diff(prev, sig)
+        self.events.append(f"{name}: {diff}")
+        logger.warning(
+            "argument signature for %r changed mid-run: a jitted step now "
+            "retraces (a full recompile); an AOT-compiled step will instead "
+            "reject the call with an argument mismatch — %s", name, diff,
+        )
+        return True
+
+    @staticmethod
+    def describe_diff(prev: dict[str, str], cur: dict[str, str]) -> str:
+        parts: list[str] = []
+        for key in sorted(set(prev) | set(cur)):
+            a, b = prev.get(key), cur.get(key)
+            if a == b:
+                continue
+            if a is None:
+                parts.append(f"{key}: added {b}")
+            elif b is None:
+                parts.append(f"{key}: removed (was {a})")
+            else:
+                parts.append(f"{key}: {a} -> {b}")
+        if len(parts) > 8:
+            parts = parts[:8] + [f"... and {len(parts) - 8} more"]
+        return "; ".join(parts) or "tree structure changed"

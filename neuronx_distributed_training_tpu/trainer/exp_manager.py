@@ -16,7 +16,8 @@ import time
 from pathlib import Path
 from typing import Any, Optional
 
-from neuronx_distributed_training_tpu.utils.perf import Throughput
+from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+from neuronx_distributed_training_tpu.utils.perf import Throughput, mfu as _mfu
 
 logger = logging.getLogger(__name__)
 
@@ -43,6 +44,8 @@ class ExpManager:
         log_files: bool = True,
         log_local_rank_0_only: bool = False,
         log_global_rank_0_only: bool = False,
+        seq_len: int = 0,
+        telemetry: Optional[TelemetryConfig] = None,
     ):
         if "://" in str(exp_dir):
             # remote store (gs:// etc.): epath keeps the scheme — Path()
@@ -69,10 +72,14 @@ class ExpManager:
         self.log_dir.mkdir(parents=True, exist_ok=True)
         self.checkpoint_dir = self.log_dir / "checkpoints"
         self.log_every_n_steps = log_every_n_steps
-        self.throughput = Throughput(global_batch_size)
+        self.throughput = Throughput(global_batch_size, seq_len=seq_len)
+        self.telemetry = telemetry if telemetry is not None else TelemetryConfig()
         self._last_tput: Optional[float] = None
         self._last_step_time: Optional[float] = None
         self._metrics_file = self.log_dir / "metrics.jsonl"
+        self._run_summary_file = self.log_dir / "run_summary.json"
+        # set by set_mfu_reference: (train-step FLOPs/token, chips, peak TF/s)
+        self._mfu_ref: Optional[tuple[float, int, float]] = None
 
         self.profile_start_step = profile_start_step
         self.profile_num_steps = profile_num_steps
@@ -174,6 +181,8 @@ class ExpManager:
             log_files=bool(em.get("log_files", True)),
             log_local_rank_0_only=bool(em.get("log_local_rank_0_only", False)),
             log_global_rank_0_only=bool(em.get("log_global_rank_0_only", False)),
+            seq_len=int((cfg.get("data", {}) or {}).get("seq_length", 0) or 0),
+            telemetry=TelemetryConfig.from_config(em.get("telemetry")),
         )
 
     # -- profiling (jax.profiler -> TensorBoard profile plugin; the TPU-native
@@ -194,18 +203,58 @@ class ExpManager:
 
     # -- per-step hooks -----------------------------------------------------
 
-    def step_timed(self, num_steps: int = 1) -> float:
+    def step_timed(self, num_steps: int = 1, exclude_seconds: float = 0.0) -> float:
         """Record a step boundary covering ``num_steps`` steps since the last
-        call; returns per-step wall seconds (0.0 on first)."""
+        call; returns per-step wall seconds (0.0 on first).
+
+        ``exclude_seconds`` — wall time since the last call spent OUTSIDE
+        steady-state training (validation, checkpointing, first-step compile;
+        the trainer passes ``SpanTimer.take_excluded()``) — is subtracted
+        before the per-step division, so the throughput window and
+        ``throughput_peak`` reflect training only instead of silently folding
+        a checkpoint stall into seq/s."""
         now = time.perf_counter()
-        dt = (
-            0.0 if self._last_step_time is None
-            else (now - self._last_step_time) / max(num_steps, 1)
-        )
+        if self._last_step_time is None:
+            dt = 0.0
+        else:
+            window = now - self._last_step_time - max(exclude_seconds, 0.0)
+            dt = max(window, 0.0) / max(num_steps, 1)
         self._last_step_time = now
         if dt > 0:
             self._last_tput = self.throughput.update(dt, num_steps=num_steps)
         return dt
+
+    def set_mfu_reference(
+        self,
+        *,
+        train_step_flops_per_token: float,
+        n_chips: int,
+        peak_tflops_per_chip: float,
+    ) -> None:
+        """Arm MFU/tokens-per-sec-per-chip logging.  The trainer calls this
+        once with the analytic per-family FLOPs estimate
+        (``utils.perf.flops_for_model`` x3 for fwd+2xbwd); from then on every
+        ``log_metrics`` derives ``mfu`` from the throughput window's
+        ``tokens_per_sec`` — one source of truth, no second timer."""
+        self._mfu_ref = (
+            float(train_step_flops_per_token), max(int(n_chips), 1),
+            float(peak_tflops_per_chip),
+        )
+
+    def write_run_summary(self, section: dict[str, Any]) -> None:
+        """Merge ``section`` into ``run_summary.json`` (next to
+        ``metrics.jsonl``): the one-shot facts of the run — compile census,
+        goodput totals — that don't belong in the per-step stream."""
+        existing: dict[str, Any] = {}
+        try:
+            with open(self._run_summary_file) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            pass
+        existing.update(section)
+        with open(self._run_summary_file, "w") as f:
+            json.dump(existing, f, indent=1, sort_keys=True)
+            f.write("\n")
 
     def log_metrics(self, step: int, metrics: dict[str, Any], *, force: bool = False) -> None:
         """Write scalars (TB + jsonl) every ``log_every_n_steps``.
@@ -219,6 +268,13 @@ class ExpManager:
         if self._last_tput is not None:
             flat["throughput_seqs_per_sec"] = self._last_tput
             flat["throughput_peak"] = self.throughput.peak
+            tokens = self.throughput.tokens_per_sec
+            if self.telemetry.mfu and self._mfu_ref is not None and tokens > 0:
+                step_flops, n_chips, peak_tf = self._mfu_ref
+                per_chip = tokens / n_chips
+                flat["tokens_per_sec_per_chip"] = per_chip
+                if peak_tf > 0:
+                    flat["mfu"] = _mfu(per_chip, step_flops, peak_tf)
         if self._tb is not None:
             for k, v in flat.items():
                 self._tb.add_scalar(k, v, step)

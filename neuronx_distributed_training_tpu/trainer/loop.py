@@ -72,6 +72,26 @@ def parse_max_time(value: Any) -> Optional[float]:
     return float(((d * 24 + h) * 60 + m) * 60 + s)
 
 
+def _device_memory_metrics(mesh) -> dict[str, float]:
+    """Live allocator stats of the first mesh device (telemetry.device_memory).
+
+    ``memory_stats()`` is a local allocator query — no device sync — but some
+    backends (CPU, older plugins) don't implement it; those log nothing."""
+    try:
+        stats = mesh.devices.flat[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 — optional observability
+        return {}
+    out: dict[str, float] = {}
+    for src, dst in (
+        ("bytes_in_use", "device_bytes_in_use"),
+        ("peak_bytes_in_use", "device_peak_bytes_in_use"),
+        ("bytes_limit", "device_bytes_limit"),
+    ):
+        if src in stats:
+            out[dst] = float(stats[src])
+    return out
+
+
 def _sidecar_load(path, tag):
     """Read a reference-logp sidecar -> (done_upto, cols) or None.
 
@@ -150,6 +170,9 @@ class Trainer:
     pre_fit: Optional[Callable] = None  # runs once before the loop (DPO ref pass)
     ema_cfg: Optional[Any] = None  # optim.adamw.EMAConfig when EMA is enabled
     pipeline_schedule: Optional[str] = None  # "1f1b"/"wavefront" under pp, else None
+    # static facts of the run (model family, chips, seq len, analytic FLOPs)
+    # persisted with the compile census into run_summary.json
+    run_facts: dict = dataclasses.field(default_factory=dict)
 
     # -- assembly -----------------------------------------------------------
 
@@ -623,6 +646,42 @@ class Trainer:
             )
 
         exp = ExpManager.from_config(cfg, global_batch_size=sched["global_batch_size"])
+
+        # -- telemetry wiring: MFU reference + the static run facts the
+        # compile census persists to run_summary.json.  The analytic FLOPs
+        # estimate (utils.perf, the reference's llama_perf_estimate role) is
+        # per-family; throughput itself stays the one source of truth —
+        # mfu derives from its tokens_per_sec at each logging boundary.
+        from neuronx_distributed_training_tpu.utils import perf as _perf
+
+        seq_len = int((cfg.get("data", {}) or {}).get("seq_length", 0) or 0) \
+            or int(getattr(data_module, "seq_len", 0) or 0)
+        if exp.throughput.seq_len == 0:
+            exp.throughput.seq_len = seq_len
+        n_chips = int(mesh.devices.size)
+        run_facts: dict = {
+            "model_family": type(model_cfg).__name__,
+            "n_chips": n_chips,
+            "seq_len": seq_len,
+            "global_batch_size": int(sched["global_batch_size"]),
+            "pipeline_schedule": pp_schedule,
+        }
+        try:
+            fwd_flops = _perf.flops_for_model(model_cfg, seq_len)
+            run_facts["fwd_flops_per_token"] = fwd_flops
+            run_facts["peak_tflops_per_chip"] = _perf.detect_peak_tflops(
+                devices[0])
+            if exp.telemetry.mfu:
+                exp.set_mfu_reference(
+                    train_step_flops_per_token=(
+                        _perf.train_step_flops_per_token(fwd_flops)),
+                    n_chips=n_chips,
+                    peak_tflops_per_chip=run_facts["peak_tflops_per_chip"],
+                )
+        except Exception as e:  # noqa: BLE001 — MFU is observability, not load-bearing
+            logger.warning("MFU estimation unavailable for %s: %s",
+                           type(model_cfg).__name__, e)
+
         checkpointer = None
         if enable_checkpointing:
             ck_cfg = CheckpointConfig.from_config(cfg)
@@ -797,7 +856,7 @@ class Trainer:
             train_step=jstep, eval_step=eval_fn, data_module=data_module,
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
             max_steps=max_steps, pre_fit=pre_fit, ema_cfg=ema_cfg,
-            pipeline_schedule=pp_schedule,
+            pipeline_schedule=pp_schedule, run_facts=run_facts,
         )
 
     # -- resume -------------------------------------------------------------
@@ -830,8 +889,20 @@ class Trainer:
     # -- the loop -----------------------------------------------------------
 
     def fit(self) -> dict[str, float]:
+        import contextlib
         import signal
         import time as _time
+
+        from neuronx_distributed_training_tpu.telemetry import (
+            RecompileDetector,
+            SpanTimer,
+        )
+
+        tel = self.exp.telemetry
+        # spans power both the per-boundary decomposition AND goodput; the
+        # timer is pure perf_counter bookkeeping, so either knob arms it
+        spans = SpanTimer(enabled=tel.spans or tel.goodput)
+        detector = RecompileDetector()
 
         cfg_t = dict(self.cfg.get("trainer", {}) or {})
         val_interval = int(cfg_t.get("val_check_interval", 0) or 0)
@@ -858,27 +929,60 @@ class Trainer:
             pass  # not in the main thread (tests); preemption hook disabled
 
         # pre_fit BEFORE resume: the DPO reference pass must see the frozen
-        # initial policy, not resumed weights (see pre_fit docstring)
-        if self.pre_fit is not None:
-            self.pre_fit(self)
-        self.maybe_resume()
+        # initial policy, not resumed weights (see pre_fit docstring).  Both
+        # are "restart" time for goodput: work a run repeats after preemption
+        # that trains nothing.
+        with spans.span("restart"):
+            if self.pre_fit is not None:
+                self.pre_fit(self)
+            self.maybe_resume()
         last_metrics: dict[str, float] = {}
         # background prefetch: slow fetch_rows (arrow page-in, mmap faults)
         # must not stall dispatch (the reference's MpDeviceLoader role);
         # shard_batch uses an explicit NamedSharding, so it is thread-safe
         batches = PrefetchIterator(self.data_module.sharded_batches(self.mesh))
         log_every = max(1, int(self.exp.log_every_n_steps))
+        census_pending = tel.compile_census
         try:
             with self.mesh, shd.use_mesh(self.mesh):
                 self.exp.step_timed()  # arm the step timer
+                # restart time predates the window just armed: drop it from
+                # the throughput exclusion (goodput still counts it)
+                spans.take_excluded()
+                first_dispatch = True
                 last_fetch = self.step
                 while self.step < self.max_steps:
                     self.exp.maybe_profile(self.step)
-                    batch = next(batches)
+                    with spans.span("data_wait"):
+                        batch = next(batches)
                     key = jax.random.fold_in(jax.random.PRNGKey(0), self.step)
-                    self.params, self.opt_state, metrics = self.train_step(
-                        self.params, self.opt_state, batch, key
+                    if census_pending:
+                        census_pending = False
+                        self._compile_census(batch, key, spans)
+                    # host-side metadata check only (shapes/dtypes — never
+                    # values): a mid-run signature change means a retrace
+                    detector.check("train_step", batch)
+                    annot = (
+                        jax.profiler.StepTraceAnnotation(
+                            "train", step_num=self.step)
+                        if tel.spans else contextlib.nullcontext()
                     )
+                    # "dispatch" is host enqueue time: under dispatch-ahead
+                    # the device runs behind and this span stays tiny; device
+                    # time that outran the host surfaces in host_sync instead.
+                    # The first call of a still-jitted step (census off or
+                    # failed) traces+compiles inline — count that one as
+                    # "compile" so it stays out of the throughput window and
+                    # goodput either way.
+                    dispatch_span = "dispatch"
+                    if first_dispatch:
+                        first_dispatch = False
+                        if hasattr(self.train_step, "lower"):
+                            dispatch_span = "compile"
+                    with spans.span(dispatch_span), annot:
+                        self.params, self.opt_state, metrics = self.train_step(
+                            self.params, self.opt_state, batch, key
+                        )
                     self.step += 1
                     if max_time is not None and stop_requested["reason"] is None:
                         if _time.monotonic() - t_start > max_time:
@@ -899,19 +1003,39 @@ class Trainer:
                         continue
                     n_since = self.step - last_fetch
                     last_fetch = self.step
-                    last_metrics = {k: float(v) for k, v in metrics.items()}
-                    dt = self.exp.step_timed(n_since)
+                    # the boundary metric fetch is the loop's ONE host sync:
+                    # any device time the host outran is absorbed here
+                    with spans.span("host_sync"):
+                        last_metrics = {k: float(v) for k, v in metrics.items()}
+                    # throughput window excludes validation/checkpoint/compile
+                    # wall time (the spans tagged non-productive) so seq/s and
+                    # throughput_peak reflect steady-state training only
+                    dt = self.exp.step_timed(
+                        n_since, exclude_seconds=spans.take_excluded()
+                    )
                     last_metrics["step_time"] = dt
                     last_metrics["consumed_samples"] = self.consumed_samples
+                    if tel.spans:
+                        last_metrics.update(
+                            {f"time/{k}": v for k, v in spans.drain().items()}
+                        )
+                    if tel.goodput:
+                        last_metrics["goodput_fraction"] = (
+                            spans.goodput_fraction())
+                    if tel.device_memory:
+                        last_metrics.update(_device_memory_metrics(self.mesh))
                     self.exp.log_metrics(self.step, last_metrics)
 
                     if val_interval and self.step % val_interval == 0 and self.eval_step:
-                        last_metrics["val_loss"] = self.validate(limit_val)
+                        with spans.span("validate"):
+                            last_metrics["val_loss"] = self.validate(
+                                limit_val, detector=detector)
                         self.exp.log_metrics(
                             self.step, {"val_loss": last_metrics["val_loss"]}, force=True
                         )
                     if ck_every and self.step % ck_every == 0:
-                        self.save_checkpoint(last_metrics)
+                        with spans.span("checkpoint"):
+                            self.save_checkpoint(last_metrics)
                     if stop_requested["reason"] is not None:
                         logger.warning(
                             "stopping at step %d: %s — checkpointing for resume",
@@ -920,11 +1044,13 @@ class Trainer:
                         if self.checkpointer is not None and (
                             not ck_every or self.step % ck_every != 0
                         ):
-                            self.save_checkpoint(last_metrics)
+                            with spans.span("checkpoint"):
+                                self.save_checkpoint(last_metrics)
                         break
                 if (ck_every and self.checkpointer is not None
                         and stop_requested["reason"] is None):
-                    self.save_checkpoint(last_metrics)  # final save
+                    with spans.span("checkpoint"):
+                        self.save_checkpoint(last_metrics)  # final save
         finally:
             batches.close()
             if old_handler is not None:
@@ -932,12 +1058,63 @@ class Trainer:
 
                 _signal.signal(_signal.SIGTERM, old_handler)
             if self.checkpointer is not None:
-                self.checkpointer.wait()
-                self.checkpointer.close()
+                with spans.span("checkpoint"):
+                    self.checkpointer.wait()
+                    self.checkpointer.close()
+            if tel.goodput:
+                try:
+                    summary: dict[str, Any] = {
+                        "goodput": spans.goodput_summary()}
+                    if detector.events:
+                        summary["retrace_events"] = detector.events[-20:]
+                    self.exp.write_run_summary(summary)
+                except Exception as e:  # noqa: BLE001 — teardown must finish
+                    logger.warning("goodput summary write failed: %s", e)
             self.exp.close()
         return last_metrics
 
-    def validate(self, limit_batches: int) -> float:
+    def _compile_census(self, batch, key, spans) -> None:
+        """First-compile census (telemetry.compile_census): AOT lower+compile
+        the train step, harvest ``memory_analysis()`` bytes / HLO collective
+        counts / the analytic FLOPs estimate into ``run_summary.json``, then
+        swap the compiled executable into the loop — the census costs ZERO
+        extra compiles because the loop runs the very executable it measured.
+        Any failure degrades to the plain jit path (observability must never
+        kill training)."""
+        if not hasattr(self.train_step, "lower"):
+            return  # already AOT-compiled, or a test double
+        import time as _time
+
+        from neuronx_distributed_training_tpu.telemetry import compile_census
+
+        try:
+            t0 = _time.perf_counter()
+            compiled = self.train_step.lower(
+                self.params, self.opt_state, batch, key
+            ).compile()
+            dt = _time.perf_counter() - t0
+            # compile is non-productive wall time: goodput + the throughput
+            # window's exclusion both see it through the span
+            spans.add("compile", dt)
+            census = compile_census(
+                compiled,
+                compile_seconds=dt,
+                flops_per_token=self.run_facts.get("fwd_flops_per_token"),
+                extra={k: v for k, v in self.run_facts.items()
+                       if k != "fwd_flops_per_token"},
+            )
+            self.exp.write_run_summary(census)
+            logger.info(
+                "compile census: %.1fs compile, collectives=%s",
+                dt, census.get("collectives"),
+            )
+            self.train_step = compiled
+        except Exception as e:  # noqa: BLE001 — census is best-effort
+            logger.warning(
+                "compile census failed; continuing with the jit path: %s", e
+            )
+
+    def validate(self, limit_batches: int, detector=None) -> float:
         params = self.params
         if (self.ema_cfg is not None
                 and self.ema_cfg.evaluate_ema_weights_instead
@@ -952,6 +1129,8 @@ class Trainer:
         for i, batch in enumerate(it):
             if i >= limit_batches:
                 break
+            if detector is not None:
+                detector.check("eval_step", batch)
             m = self.eval_step(params, batch, jax.random.PRNGKey(0))
             losses.append(float(m["val_loss"]))
         return float(np.mean(losses)) if losses else float("nan")

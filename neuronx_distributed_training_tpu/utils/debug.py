@@ -92,7 +92,15 @@ def collective_counts(jitted_fn, *args, **kwargs) -> dict[str, int]:
     Works on anything with ``.lower()`` (a ``jax.jit`` result).  ``-start``
     variants (async collectives) count once, not twice.
     """
-    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    return collective_counts_from_compiled(
+        jitted_fn.lower(*args, **kwargs).compile()
+    )
+
+
+def collective_counts_from_compiled(compiled) -> dict[str, int]:
+    """Collective census of an ALREADY-compiled executable (`.compile()`
+    result) — the zero-extra-compile path the telemetry census uses on the
+    train step it is about to run."""
     texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()]
     counts = {k: 0 for k in _COLLECTIVES}
     # HLO line shapes: `%name = f32[4,8]{1,0} all-reduce(%dot), ...` and the

@@ -96,13 +96,24 @@ def mfu(
 
 class Throughput:
     """Moving-average sequences/sec with peak tracking, mirroring the
-    reference's ``Throughput`` (``utils/utils.py:52-77``, window=10)."""
+    reference's ``Throughput`` (``utils/utils.py:52-77``, window=10).
 
-    def __init__(self, batch_size: int, window: int = 10):
+    ``peak`` is only recorded once the window holds at least
+    ``min(window, 3)`` samples: the first one or two windows average over a
+    partial history and a single fast boundary there would pin a phantom
+    peak no steady-state window can ever reach again.
+
+    ``seq_len`` (when given) makes ``tokens_per_sec`` the one source of
+    truth tokens-based metrics (MFU, tokens/sec/chip) derive from.
+    """
+
+    def __init__(self, batch_size: int, window: int = 10, seq_len: int = 0):
         self.batch_size = batch_size
         self.window = window
+        self.seq_len = int(seq_len or 0)
         self._times: list[float] = []
         self.peak = 0.0
+        self.last = 0.0
         self.total_seqs = 0
 
     def update(self, step_seconds: float, num_steps: int = 1) -> float:
@@ -111,8 +122,15 @@ class Throughput:
             self._times.pop(0)
         self.total_seqs += self.batch_size * num_steps
         tput = self.batch_size * len(self._times) / sum(self._times)
-        self.peak = max(self.peak, tput)
+        self.last = tput
+        if len(self._times) >= min(self.window, 3):
+            self.peak = max(self.peak, tput)
         return tput
+
+    @property
+    def tokens_per_sec(self) -> float:
+        """Windowed tokens/sec (seqs/s x seq_len); 0.0 when seq_len unset."""
+        return self.last * self.seq_len
 
 
 def flops_for_config(model_cfg: Any, seq_len: int) -> float:
@@ -127,3 +145,59 @@ def flops_for_config(model_cfg: Any, seq_len: int) -> float:
         seq_len=seq_len,
         head_dim=getattr(model_cfg, "head_dim", None),
     )
+
+
+def flops_for_model(model_cfg: Any, seq_len: int) -> float:
+    """fwd FLOPs/token for ANY supported model family — the MFU dispatch.
+
+    llama/mistral use the Llama accounting directly; mixtral swaps the dense
+    MLP term for top-k routed experts + the router matmul on its MoE layers;
+    megatron GPT swaps SwiGLU for its configured activation (GLU: 3 matmuls,
+    plain: 2) and honors optional MoE.  Only ACTIVATED expert FLOPs count —
+    MFU measures useful work per token, and an unrouted expert does none.
+    """
+    from neuronx_distributed_training_tpu.models import gpt as _gpt
+    from neuronx_distributed_training_tpu.models import mixtral as _mx
+
+    if isinstance(model_cfg, _mx.MixtralConfig):
+        lc = model_cfg.llama
+        # attention + logits from the llama model with the MLP term zeroed
+        base = llama_flops_per_token(
+            num_layers=lc.num_layers,
+            hidden_size=lc.hidden_size,
+            intermediate_size=0,
+            num_attention_heads=lc.num_attention_heads,
+            num_kv_heads=lc.num_kv_heads,
+            vocab_size=lc.vocab_size,
+            seq_len=seq_len,
+            head_dim=getattr(lc, "head_dim", None),
+        )
+        n_moe = _mx.num_moe_layers(model_cfg)
+        n_dense = lc.num_layers - n_moe
+        swiglu = 2 * lc.hidden_size * 3 * lc.intermediate_size
+        router = 2 * lc.hidden_size * model_cfg.moe.num_experts
+        return (base
+                + n_dense * swiglu
+                + n_moe * (model_cfg.moe.top_k * swiglu + router))
+    if isinstance(model_cfg, _gpt.GPTConfig):
+        base = llama_flops_per_token(
+            num_layers=model_cfg.num_layers,
+            hidden_size=model_cfg.hidden_size,
+            intermediate_size=0,
+            num_attention_heads=model_cfg.num_attention_heads,
+            num_kv_heads=model_cfg.kv_heads,
+            vocab_size=model_cfg.vocab_size,
+            seq_len=seq_len,
+            head_dim=model_cfg.head_size,
+        )
+        matmuls = 3 if model_cfg.is_glu else 2  # (gate,) up, down
+        mlp = 2 * model_cfg.hidden_size * matmuls * model_cfg.ffn_size
+        if model_cfg.moe is not None:
+            n_moe = _gpt.num_moe_layers(model_cfg)
+            n_dense = model_cfg.num_layers - n_moe
+            router = 2 * model_cfg.hidden_size * model_cfg.moe.num_experts
+            return (base + n_dense * mlp
+                    + n_moe * (model_cfg.moe.top_k * mlp + router))
+        return base + model_cfg.num_layers * mlp
+    # llama/mistral (and anything exposing the same shape attributes)
+    return flops_for_config(model_cfg, seq_len)

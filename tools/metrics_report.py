@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Render a run's ``metrics.jsonl`` + ``run_summary.json`` as a terminal table.
+
+The quick ocular check before reaching for TensorBoard: last/mean/peak per
+logged metric, the goodput breakdown, and the compile census — everything the
+unified telemetry layer wrote, in one screen.
+
+    python tools/metrics_report.py nxdt_experiments/hf_llama3_8B/version_0
+    python tools/metrics_report.py path/to/metrics.jsonl --last 50
+
+Pure stdlib on purpose: it must run on a login node with nothing installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+def load_metrics(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line from a live run
+    return records
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "nan"
+    a = abs(v)
+    if a != 0 and (a >= 1e6 or a < 1e-3):
+        return f"{v:.3e}"
+    if a >= 100 or float(v).is_integer():
+        return f"{v:,.1f}" if not float(v).is_integer() else f"{v:,.0f}"
+    return f"{v:.4f}"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _table(rows: list[tuple[str, ...]], header: tuple[str, ...]) -> str:
+    widths = [max(len(str(r[i])) for r in [header, *rows])
+              for i in range(len(header))]
+    def fmt_row(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt_row(header), sep, *(fmt_row(r) for r in rows)])
+
+
+def metrics_table(records: list[dict], last_n: int = 0) -> str:
+    if last_n > 0:
+        records = records[-last_n:]
+    by_key: dict[str, list[float]] = {}
+    for rec in records:
+        for k, v in rec.items():
+            if k == "step" or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, float) and math.isnan(v):
+                continue
+            by_key.setdefault(k, []).append(float(v))
+    rows = []
+    for k in sorted(by_key):
+        vals = by_key[k]
+        rows.append((k, _fmt(vals[-1]), _fmt(sum(vals) / len(vals)),
+                     _fmt(max(vals)), str(len(vals))))
+    steps = [r.get("step") for r in records if isinstance(r.get("step"), int)]
+    span = f"steps {steps[0]}..{steps[-1]}" if steps else "no steps"
+    return (f"metrics ({span}, {len(records)} boundary records)\n"
+            + _table(rows, ("metric", "last", "mean", "peak", "n")))
+
+
+def goodput_section(summary: dict) -> str:
+    gp = summary.get("goodput")
+    if not gp:
+        return ""
+    lines = ["", "goodput"]
+    frac = gp.get("goodput_fraction")
+    if frac is not None:
+        lines.append(f"  goodput_fraction      {frac:.4f}")
+    for key in ("wall_seconds", "productive_seconds", "nonproductive_seconds"):
+        if key in gp:
+            lines.append(f"  {key:<21} {_fmt(gp[key])}")
+    for name, secs in sorted((gp.get("breakdown_seconds") or {}).items()):
+        lines.append(f"    {name:<19} {_fmt(secs)} s")
+    return "\n".join(lines)
+
+
+def census_section(summary: dict) -> str:
+    lines: list[str] = []
+    if "compile_seconds" in summary:
+        lines.append(f"  compile_seconds       {_fmt(summary['compile_seconds'])}")
+    mem = summary.get("memory_analysis") or {}
+    for key in ("peak_bytes", "temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes"):
+        if key in mem:
+            lines.append(f"  {key:<21} {_fmt_bytes(mem[key])}")
+    coll = summary.get("collectives") or {}
+    nonzero = {k: v for k, v in coll.items() if v}
+    if coll:
+        lines.append("  collectives           "
+                     + (", ".join(f"{k}={v}" for k, v in sorted(nonzero.items()))
+                        or "none"))
+    for key in ("model_family", "n_chips", "seq_len", "global_batch_size",
+                "pipeline_schedule", "fwd_flops_per_token",
+                "train_step_flops_per_token", "peak_tflops_per_chip"):
+        if summary.get(key) is not None:
+            v = summary[key]
+            lines.append(f"  {key:<21} {_fmt(v) if isinstance(v, (int, float)) else v}")
+    if summary.get("retrace_events"):
+        lines.append(f"  retrace_events        {len(summary['retrace_events'])} "
+                     f"(see run_summary.json — each cost a recompile)")
+    if not lines:
+        return ""
+    return "\n".join(["", "compile census / run facts", *lines])
+
+
+def render(metrics_path: str | None, summary_path: str | None,
+           last_n: int = 0) -> str:
+    parts: list[str] = []
+    if metrics_path and os.path.exists(metrics_path):
+        records = load_metrics(metrics_path)
+        if records:
+            parts.append(metrics_table(records, last_n))
+        else:
+            parts.append(f"no records in {metrics_path}")
+    summary = {}
+    if summary_path and os.path.exists(summary_path):
+        try:
+            with open(summary_path) as f:
+                summary = json.load(f)
+        except ValueError as e:
+            parts.append(f"unreadable {summary_path}: {e}")
+    if summary:
+        parts.append(goodput_section(summary))
+        parts.append(census_section(summary))
+    return "\n".join(p for p in parts if p)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (containing metrics.jsonl / "
+                                 "run_summary.json) or a metrics.jsonl file")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the last N boundary records (default: all)")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        metrics_path = os.path.join(path, "metrics.jsonl")
+        summary_path = os.path.join(path, "run_summary.json")
+    elif path.endswith(".jsonl"):
+        metrics_path = path
+        summary_path = os.path.join(os.path.dirname(path), "run_summary.json")
+    else:
+        metrics_path, summary_path = None, path
+    if not any(p and os.path.exists(p) for p in (metrics_path, summary_path)):
+        print(f"metrics_report: nothing to read at {path}", file=sys.stderr)
+        return 2
+    print(render(metrics_path, summary_path, args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
