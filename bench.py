@@ -83,6 +83,16 @@ def record_measurement(payload: dict, refresh_last: bool = True) -> None:
         log(f"bench: could not record measurement: {e}")
 
 
+def json_float(v, ndigits: int = 4):
+    """NaN/Inf-safe JSON scalar: json.dumps would emit bare ``NaN`` (invalid
+    JSON) for exactly the diverging runs the health fields exist to flag."""
+    import math
+
+    if v is None or not isinstance(v, (int, float)):
+        return v
+    return round(float(v), ndigits) if math.isfinite(v) else repr(float(v))
+
+
 def fail_json(err: str, **extra) -> None:
     emit({
         "metric": "llama3_8B_pretrain_mfu",
@@ -362,6 +372,7 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
     from neuronx_distributed_training_tpu.optim.lr import constant_lr
     from neuronx_distributed_training_tpu.parallel import sharding as shd
     from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from neuronx_distributed_training_tpu.telemetry import HealthConfig
     from neuronx_distributed_training_tpu.trainer.step import (
         jit_train_step, make_train_step,
     )
@@ -376,15 +387,24 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
             tree, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
         )
         params = put(params, pspecs)
-        opt_state = init_opt_state(params, policy)
-        ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy)
+        # numerics health rides the bench step exactly as it rides the
+        # trainer's (telemetry.health): the in-graph finiteness counters let
+        # the JSON line distinguish a fast-but-diverging run (nonfinite
+        # steps, exploding final grad norm) from a healthy one
+        # param_norm off: bench never reports it, and the full-parameter
+        # norm reduction would sit inside the timed loop skewing ms_per_step
+        health = HealthConfig(enabled=True, policy="dump_and_continue",
+                              param_norm=False)
+        opt_state = init_opt_state(params, policy, health=True)
+        ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy,
+                                 health=True)
         opt_state = put(opt_state, ospecs)
 
         def loss_fn(p, batch, step_key):
             return llama.forward(p, batch, cfg, policy)
 
         step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy,
-                               param_specs=pspecs)
+                               param_specs=pspecs, health_cfg=health)
         jstep = jit_train_step(step, mesh, pspecs, ospecs)
 
         ids = jax.random.randint(
@@ -429,6 +449,13 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
             params, opt_state, metrics = compiled(params, opt_state, batch, key)
         _ = float(metrics["loss"])  # fence: forces the whole dependent chain
         elapsed = time.perf_counter() - t0
+        # health counters: fetched AFTER the fence, outside the timed window
+        nonfinite_steps = int(metrics["health/nonfinite_count"])
+        skipped_updates = int(metrics["health/skipped_count"])
+        final_grad_norm = float(metrics["grad_norm"])
+        if nonfinite_steps:
+            log(f"bench: WARNING {nonfinite_steps} non-finite steps — the "
+                f"throughput number is for a DIVERGING run")
         # the rtt correction must stay a correction — never let it swallow the
         # measurement and report a fantasy number
         rtt = min(rtt, 0.1 * elapsed)
@@ -453,6 +480,11 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         "compile_seconds": round(compile_seconds, 2),
         "collectives": census.get("collectives"),
         "memory_analysis": census.get("memory_analysis"),
+        # numerics-health fields (telemetry.health): a throughput line from a
+        # diverging run must be distinguishable from a healthy one
+        "nonfinite_steps": nonfinite_steps,
+        "skipped_updates": skipped_updates,
+        "final_grad_norm": json_float(final_grad_norm),
     }
 
 
@@ -627,6 +659,10 @@ def main() -> None:
         "compile_seconds": r.get("compile_seconds"),
         "collectives": r.get("collectives"),
         "memory_analysis": r.get("memory_analysis"),
+        # numerics health (telemetry.health): fast-but-diverging vs healthy
+        "nonfinite_steps": r.get("nonfinite_steps"),
+        "skipped_updates": r.get("skipped_updates"),
+        "final_grad_norm": r.get("final_grad_norm"),
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }

@@ -341,8 +341,10 @@ def validate_config(cfg: ConfigDict) -> None:
 
     # ---- exp_manager.telemetry -------------------------------------------
     # the unified step-telemetry knob block (spans/mfu/compile_census/
-    # device_memory/goodput); a typo'd knob must die here, not silently run
-    # with defaults
+    # device_memory/goodput) plus the nested ``health`` flight-recorder block
+    # (enabled/policy/ring_buffer_steps/watchdog_*; HealthConfig validates it
+    # through the same call); a typo'd knob or policy must die here, not
+    # silently run with defaults
     em = cfg.get("exp_manager", {}) or {}
     if isinstance(em, Mapping) and "telemetry" in em:
         from neuronx_distributed_training_tpu.telemetry import TelemetryConfig

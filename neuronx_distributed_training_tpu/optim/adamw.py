@@ -99,10 +99,29 @@ def decay_mask(params, cfg: AdamWConfig):
     return jax.tree_util.tree_map_with_path(leaf_mask, params)
 
 
-def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = False):
+#: scalar counters threaded through ``opt_state["health"]`` when the numerics
+#: flight recorder is enabled: they ride the donated state step-to-step,
+#: survive checkpoints, and reach the host for free inside the boundary
+#: metric fetch (``last_nonfinite_step`` starts at -1 = "never")
+HEALTH_STATE_KEYS = (
+    "steps_seen", "nonfinite_count", "skipped_count", "last_nonfinite_step",
+)
+
+
+def init_health_state():
+    return {
+        "steps_seen": jnp.zeros((), jnp.int32),
+        "nonfinite_count": jnp.zeros((), jnp.int32),
+        "skipped_count": jnp.zeros((), jnp.int32),
+        "last_nonfinite_step": jnp.full((), -1, jnp.int32),
+    }
+
+
+def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = False,
+                   health: bool = False):
     """Opt state: step counter, fp32 moments, fp32 master weights when the
-    params themselves are stored in a lower precision, and (optionally) the
-    weight-EMA tree."""
+    params themselves are stored in a lower precision, (optionally) the
+    weight-EMA tree, and (optionally) the numerics-health counters."""
     policy = policy or DtypePolicy()
     odt = policy.optimizer_dtype
 
@@ -118,6 +137,8 @@ def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = Fal
         state["master"] = jax.tree_util.tree_map(lambda x: x.astype(odt), params)
     if ema:
         state["ema"] = jax.tree_util.tree_map(lambda x: x.astype(odt), params)
+    if health:
+        state["health"] = init_health_state()
     return state
 
 
@@ -126,6 +147,21 @@ def global_norm(tree) -> jax.Array:
     return jnp.sqrt(
         sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
     )
+
+
+def grouped_sq_norms(tree, group_fn: Callable) -> dict[str, jax.Array]:
+    """Per-group sums of squares over a pytree (fp32).
+
+    ``group_fn(path) -> str`` names each leaf's group.  The per-leaf squared
+    sums are the SAME reductions ``global_norm`` performs — the caller derives
+    the global norm as ``sqrt(sum(values))``, so grouped health norms and the
+    clipping norm share one reduction pass (one source of truth)."""
+    sums: dict[str, jax.Array] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = group_fn(path)
+        s = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        sums[key] = sums[key] + s if key in sums else s
+    return sums
 
 
 def adamw_update(
@@ -137,18 +173,58 @@ def adamw_update(
     policy: DtypePolicy | None = None,
     trainable_mask=None,
     ema_cfg: Optional[EMAConfig] = None,
+    *,
+    grad_group_fn: Optional[Callable] = None,
+    skip_nonfinite: bool = False,
+    extra_finite=None,
 ):
     """One AdamW step. Returns (new_params, new_opt_state, metrics).
 
     ``trainable_mask`` (pytree of 0/1, e.g. ``peft.lora.trainable_mask``)
     freezes masked-out params completely: no grad, no moment update, no weight
-    decay — the LoRA/PEFT freeze."""
+    decay — the LoRA/PEFT freeze.
+
+    Numerics-health hooks (``telemetry.health``):
+
+    - ``grad_group_fn(path) -> str``: when set, metrics gains ``group_norms``
+      (per-layer-group pre-clip grad norms) and the global clipping norm is
+      DERIVED from the same per-leaf squared sums — one reduction pass, not a
+      second one.
+    - ``skip_nonfinite=True``: the whole update (params, moments, master, EMA,
+      step counter) is replaced leaf-wise by the incoming state when the
+      update is non-finite — an in-graph ``select``, so a poisoned batch
+      leaves params bitwise-unchanged with no recompile and no host
+      round-trip (the grad-scaler-skip behavior without a dynamic scale).
+    - ``extra_finite``: extra boolean ANDed into the finite flag (the caller
+      passes loss finiteness so a NaN loss with, e.g., masked-to-zero grads
+      still counts as a skip).
+
+    ``metrics["updates_finite"]`` (bool) is reported whenever any hook is
+    active."""
     policy = policy or DtypePolicy()
     step = opt_state["step"] + 1
     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
     if trainable_mask is not None:
         grads = jax.tree_util.tree_map(lambda g, m: g * m, grads, trainable_mask)
-    gnorm = global_norm(grads)
+    group_sq = None
+    if grad_group_fn is not None:
+        group_sq = grouped_sq_norms(grads, grad_group_fn)
+        total = None
+        for s in group_sq.values():
+            total = s if total is None else total + s
+        gnorm = jnp.sqrt(total if total is not None else jnp.zeros((), jnp.float32))
+    else:
+        gnorm = global_norm(grads)
+    track_finite = skip_nonfinite or grad_group_fn is not None \
+        or extra_finite is not None
+    updates_finite = None
+    if track_finite:
+        # any non-finite grad leaf poisons the squared-sum chain, so one
+        # isfinite on the global norm covers the whole grad tree
+        updates_finite = jnp.isfinite(gnorm)
+        if extra_finite is not None:
+            updates_finite = jnp.logical_and(
+                updates_finite, jnp.asarray(extra_finite, bool))
     if cfg.grad_clip_norm is not None and cfg.grad_clip_norm > 0:
         clip = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-6))
         grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
@@ -202,7 +278,21 @@ def adamw_update(
             opt_state["ema"], new_master,
         )
     new_params = jax.tree_util.tree_map(lambda x, p: x.astype(p.dtype), new_master, params)
+    if skip_nonfinite:
+        # in-graph skip: a select per leaf keeps params/moments/master/EMA AND
+        # the step counter (bias correction must not advance on a skipped
+        # step) bitwise-identical to the incoming state when non-finite
+        keep = lambda new, old: jnp.where(updates_finite, new, old)
+        new_params = jax.tree_util.tree_map(keep, new_params, params)
+        new_state = {
+            k: jax.tree_util.tree_map(keep, v, opt_state[k])
+            for k, v in new_state.items()
+        }
     metrics = {"grad_norm": gnorm}
+    if updates_finite is not None:
+        metrics["updates_finite"] = updates_finite
+    if group_sq is not None:
+        metrics["group_norms"] = {k: jnp.sqrt(v) for k, v in group_sq.items()}
     return new_params, new_state, metrics
 
 
@@ -243,7 +333,8 @@ def zero1_leaf_spec(spec: P, shape, mesh: Mesh, dp_axes=("data", "expert")) -> P
 
 def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
                     policy: DtypePolicy | None = None,
-                    zero1_exclude: tuple = (), ema: bool = False):
+                    zero1_exclude: tuple = (), ema: bool = False,
+                    health: bool = False):
     """Spec pytree matching ``init_opt_state`` output.
 
     ``zero1_exclude`` names path substrings whose moments keep the plain param
@@ -275,4 +366,6 @@ def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
         out["master"] = moment_specs
     if ema:
         out["ema"] = moment_specs
+    if health:
+        out["health"] = {k: P() for k in HEALTH_STATE_KEYS}
     return out

@@ -4,9 +4,13 @@ The observable surface the reference ships piecemeal (NeMo ``TimingCallback``,
 ``llama_perf_estimate.py``, profiler hooks) as ONE subsystem the trainer
 threads through every sink: per-step span decomposition (``spans``), a
 first-compile memory/collective/FLOPs census persisted to ``run_summary.json``
-(``census``), retrace detection (``recompile``), and the ``exp_manager:
-telemetry:`` knob block that gates it all (``config``).  Everything here is
-host-side bookkeeping — no device syncs between logging boundaries.
+(``census``), retrace detection (``recompile``), the numerics flight recorder
+(in-graph health probes in ``health``, ring buffer / anomaly bundles / hang
+watchdog in ``flight_recorder``), and the ``exp_manager: telemetry:`` knob
+block that gates it all (``config``).  Everything here is host-side
+bookkeeping — no device syncs between logging boundaries (the anomaly dump
+path, which only runs once a step has already gone non-finite, is the one
+deliberate exception).
 """
 
 from neuronx_distributed_training_tpu.telemetry.census import (
@@ -17,6 +21,15 @@ from neuronx_distributed_training_tpu.telemetry.config import (
     TELEMETRY_KNOBS,
     TelemetryConfig,
 )
+from neuronx_distributed_training_tpu.telemetry.flight_recorder import (
+    HangWatchdog,
+    HealthMonitor,
+)
+from neuronx_distributed_training_tpu.telemetry.health import (
+    HEALTH_POLICIES,
+    HealthConfig,
+    grad_group_of,
+)
 from neuronx_distributed_training_tpu.telemetry.recompile import RecompileDetector
 from neuronx_distributed_training_tpu.telemetry.spans import (
     NON_PRODUCTIVE_SPANS,
@@ -24,11 +37,16 @@ from neuronx_distributed_training_tpu.telemetry.spans import (
 )
 
 __all__ = [
+    "HEALTH_POLICIES",
+    "HangWatchdog",
+    "HealthConfig",
+    "HealthMonitor",
     "NON_PRODUCTIVE_SPANS",
     "RecompileDetector",
     "SpanTimer",
     "TELEMETRY_KNOBS",
     "TelemetryConfig",
     "compile_census",
+    "grad_group_of",
     "memory_analysis_bytes",
 ]

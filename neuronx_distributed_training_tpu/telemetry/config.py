@@ -12,9 +12,14 @@ manager, and the config validator all agree on the schema:
         compile_census: true  # first-compile memory/collective/FLOPs census
         device_memory: false  # per-boundary live HBM stats (memory_stats())
         goodput: true         # cumulative productive-seconds accounting
+        health:               # numerics flight recorder (telemetry.health)
+          enabled: false
+          policy: dump_and_continue
 
 Everything defaults ON except ``device_memory`` (``memory_stats()`` is a
-backend query some runtimes answer slowly) — the layer is designed to be
+backend query some runtimes answer slowly) and ``health`` (its anomaly
+counters live inside the optimizer state, so enabling it changes the
+checkpoint tree — an explicit opt-in) — the layer is designed to be
 cheap enough to leave on: span timing is ``time.perf_counter`` bookkeeping,
 MFU is arithmetic on the already-maintained throughput window, and the census
 runs once at first compile.  None of the knobs adds a host sync between
@@ -26,7 +31,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping
 
-#: knob name -> default; the single source of truth for schema validation
+from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
+
+#: boolean knob name -> default; the single source of truth for schema
+#: validation (the nested ``health`` block validates via HealthConfig)
 TELEMETRY_KNOBS: dict[str, bool] = {
     "spans": True,
     "mfu": True,
@@ -43,6 +51,7 @@ class TelemetryConfig:
     compile_census: bool = True
     device_memory: bool = False
     goodput: bool = True
+    health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
 
     @classmethod
     def from_config(cls, block: Any) -> "TelemetryConfig":
@@ -56,22 +65,27 @@ class TelemetryConfig:
         if block is None:
             return cls()
         if isinstance(block, bool):
-            return cls(**{k: block and v for k, v in TELEMETRY_KNOBS.items()}) \
-                if block else cls(**{k: False for k in TELEMETRY_KNOBS})
+            # blanket bool switches the boolean knobs (True keeps each knob's
+            # default, False forces all off); health (an opt-in that changes
+            # the opt-state tree) stays at its default: disabled
+            return cls(**{k: block and v for k, v in TELEMETRY_KNOBS.items()})
         if not isinstance(block, Mapping):
             raise ValueError(
                 f"exp_manager.telemetry must be a mapping of "
-                f"{sorted(TELEMETRY_KNOBS)} to booleans (or a single bool), "
+                f"{sorted(TELEMETRY_KNOBS) + ['health']} (or a single bool), "
                 f"got {type(block).__name__}"
             )
-        unknown = set(block) - set(TELEMETRY_KNOBS)
+        unknown = set(block) - set(TELEMETRY_KNOBS) - {"health"}
         if unknown:
             raise ValueError(
                 f"unknown exp_manager.telemetry keys {sorted(unknown)}; "
-                f"supported: {sorted(TELEMETRY_KNOBS)}"
+                f"supported: {sorted(TELEMETRY_KNOBS) + ['health']}"
             )
-        values: dict[str, bool] = {}
+        values: dict[str, Any] = {}
         for k, v in block.items():
+            if k == "health":
+                values[k] = HealthConfig.from_config(v)
+                continue
             if not isinstance(v, bool):
                 raise ValueError(
                     f"exp_manager.telemetry.{k} must be a boolean, got {v!r}"

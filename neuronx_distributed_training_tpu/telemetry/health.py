@@ -1,0 +1,162 @@
+"""``exp_manager.telemetry.health`` — in-graph numerics health probes.
+
+The trainer is fast but blind to numerics: a NaN loss, a divergent grad norm,
+or a silently poisoned optimizer state surfaces hours later as a dead run with
+no forensic trail.  This module is the *in-graph* half of the numerics flight
+recorder (the host-side half — ring buffer, anomaly bundles, hang watchdog —
+lives in ``telemetry.flight_recorder``):
+
+- a compact health pytree computed INSIDE the jitted train step, so it rides
+  the existing compile (zero extra executables) and costs no host sync on
+  healthy steps: per-layer-group grad norms whose squared sums also *produce*
+  the global clipping norm (one reduction pass, one source of truth —
+  ``optim.adamw.adamw_update(grad_group_fn=...)``), loss finiteness, a
+  param-norm probe, and an ``updates_finite`` flag;
+- cumulative anomaly counters carried in ``opt_state["health"]`` (so they
+  thread step-to-step through the same donated state, survive checkpoints,
+  and reach the host for free inside the boundary metric fetch the loop
+  already performs);
+- the ``skip_update`` policy: the AdamW update is zeroed in-graph via the
+  finite flag (a ``select`` on every leaf — no recompile, no host round-trip,
+  params bitwise-unchanged on the poisoned step), the NeMo/apex
+  grad-scaler-skip behavior without a dynamic loss scale.
+
+Knob block (validated through ``TelemetryConfig.from_config`` at config load):
+
+.. code-block:: yaml
+
+    exp_manager:
+      telemetry:
+        health:
+          enabled: true
+          policy: dump_and_continue   # halt | skip_update | dump_and_continue
+          ring_buffer_steps: 32       # flight-recorder depth (host-side)
+          param_norm: true            # in-graph param-norm drift probe
+          max_bundles: 8              # stop dumping after N anomaly bundles
+          watchdog_timeout_seconds: 0 # hung-device-sync watchdog (0 = off)
+          watchdog_abort: true        # SIGABRT after a hang dump
+
+Anomaly *detection* happens at the loop's existing sync boundaries (every
+``log_every_n_steps``), preserving the dispatch-ahead contract; the
+``skip_update`` protection itself is in-graph and therefore zero-latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+#: supported anomaly policies, in escalation order
+HEALTH_POLICIES = ("dump_and_continue", "skip_update", "halt")
+
+
+def _health_knobs() -> set[str]:
+    """Accepted knob names — derived from the dataclass fields so there is
+    exactly one place defaults live."""
+    return {f.name for f in dataclasses.fields(HealthConfig)}
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    enabled: bool = False
+    policy: str = "dump_and_continue"
+    ring_buffer_steps: int = 32
+    param_norm: bool = True
+    max_bundles: int = 8
+    watchdog_timeout_seconds: float = 0.0
+    watchdog_abort: bool = True
+
+    @classmethod
+    def from_config(cls, block: Any) -> "HealthConfig":
+        """Parse (and validate) an ``exp_manager.telemetry.health`` block.
+
+        Accepts ``None`` (defaults: disabled), a bare bool (``health: true``
+        enables with defaults), or a mapping of knobs.  Unknown keys and
+        out-of-range values raise ``ValueError`` — a typo'd policy must not
+        silently run ``dump_and_continue``.
+        """
+        if block is None:
+            return cls()
+        if isinstance(block, bool):
+            return cls(enabled=block)
+        knobs = _health_knobs()
+        if not isinstance(block, Mapping):
+            raise ValueError(
+                f"exp_manager.telemetry.health must be a mapping of "
+                f"{sorted(knobs)} (or a single bool), got "
+                f"{type(block).__name__}"
+            )
+        unknown = set(block) - knobs
+        if unknown:
+            raise ValueError(
+                f"unknown exp_manager.telemetry.health keys {sorted(unknown)}; "
+                f"supported: {sorted(knobs)}"
+            )
+        values = dict(block)
+        policy = str(values.get("policy", cls.policy))
+        if policy not in HEALTH_POLICIES:
+            raise ValueError(
+                f"exp_manager.telemetry.health.policy must be one of "
+                f"{'/'.join(HEALTH_POLICIES)}, got {policy!r}"
+            )
+        for key in ("enabled", "param_norm", "watchdog_abort"):
+            if key in values and not isinstance(values[key], bool):
+                raise ValueError(
+                    f"exp_manager.telemetry.health.{key} must be a boolean, "
+                    f"got {values[key]!r}"
+                )
+        out = cls(
+            enabled=bool(values.get("enabled", cls.enabled)),
+            policy=policy,
+            ring_buffer_steps=int(values.get("ring_buffer_steps",
+                                             cls.ring_buffer_steps)),
+            param_norm=bool(values.get("param_norm", cls.param_norm)),
+            max_bundles=int(values.get("max_bundles", cls.max_bundles)),
+            watchdog_timeout_seconds=float(
+                values.get("watchdog_timeout_seconds",
+                           cls.watchdog_timeout_seconds)),
+            watchdog_abort=bool(values.get("watchdog_abort",
+                                           cls.watchdog_abort)),
+        )
+        if out.ring_buffer_steps < 1:
+            raise ValueError(
+                f"exp_manager.telemetry.health.ring_buffer_steps must be >= 1, "
+                f"got {out.ring_buffer_steps}"
+            )
+        if out.max_bundles < 1:
+            raise ValueError(
+                f"exp_manager.telemetry.health.max_bundles must be >= 1, got "
+                f"{out.max_bundles} (disable the recorder with enabled: "
+                f"false instead)"
+            )
+        if out.watchdog_timeout_seconds < 0:
+            raise ValueError(
+                f"exp_manager.telemetry.health.watchdog_timeout_seconds must "
+                f"be >= 0 (0 disables the watchdog), got "
+                f"{out.watchdog_timeout_seconds}"
+            )
+        if out.watchdog_timeout_seconds > 0 and not out.enabled:
+            raise ValueError(
+                "exp_manager.telemetry.health.watchdog_timeout_seconds > 0 "
+                "requires health.enabled: true (the watchdog dumps through "
+                "the flight recorder) — it would otherwise silently never arm"
+            )
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def grad_group_of(path: Any) -> str:
+    """Map a grad-tree key path to its layer-group name.
+
+    Grouping rule: drop the leaf name, keep the first two remaining path
+    components — ``("layers","attn","qkv","w")`` -> ``layers/attn``,
+    ``("embed","embedding")`` -> ``embed``, ``("final_norm","scale")`` ->
+    ``final_norm``.  Coarse enough to stay a handful of scalars per step,
+    fine enough to localize a blow-up to attention vs MLP vs embedding in
+    the forensic bundle.
+    """
+    parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+    head = parts[:-1][:2] if len(parts) > 1 else parts
+    return "/".join(head).lower() or "params"

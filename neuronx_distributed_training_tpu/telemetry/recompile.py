@@ -48,26 +48,44 @@ class RecompileDetector:
     """Warns (once per change) when a jitted fn's abstract arg signature
     changes mid-run — the retrace-about-to-happen signal."""
 
+    #: retained event cap — a loader alternating between two signatures fires
+    #: every step; the tail is what run_summary.json reports anyway
+    MAX_EVENTS = 100
+
     def __init__(self) -> None:
         self._seen: dict[str, dict[str, str]] = {}
+        self._warned: set[str] = set()
         self.events: list[str] = []
 
     def check(self, name: str, *args: Any) -> bool:
         """Record ``args``' signature under ``name``; returns True (and
-        warns with the offending diff) when it changed since the last call."""
+        warns with the offending diff — once per distinct diff, so an
+        alternating loader can't flood the log) when it changed since the
+        last call."""
         sig = _signature(args)
         prev = self._seen.get(name)
         self._seen[name] = sig
         if prev is None or prev == sig:
             return False
         diff = self.describe_diff(prev, sig)
-        self.events.append(f"{name}: {diff}")
-        logger.warning(
-            "argument signature for %r changed mid-run: a jitted step now "
-            "retraces (a full recompile); an AOT-compiled step will instead "
-            "reject the call with an argument mismatch — %s", name, diff,
-        )
+        event = f"{name}: {diff}"
+        self.events.append(event)
+        del self.events[:-self.MAX_EVENTS]
+        if event not in self._warned:
+            self._warned.add(event)
+            logger.warning(
+                "argument signature for %r changed mid-run: a jitted step now "
+                "retraces (a full recompile); an AOT-compiled step will "
+                "instead reject the call with an argument mismatch — %s",
+                name, diff,
+            )
         return True
+
+    def signature(self, name: str) -> dict[str, str] | None:
+        """The last recorded abstract signature for ``name`` — the batch
+        fingerprint the numerics flight recorder ring-buffers per step (pure
+        host metadata, shared with the retrace check: one source of truth)."""
+        return self._seen.get(name)
 
     @staticmethod
     def describe_diff(prev: dict[str, str], cur: dict[str, str]) -> str:

@@ -69,6 +69,12 @@ class SpanTimer:
         out, self._since_drain = self._since_drain, {}
         return out
 
+    def snapshot(self) -> dict[str, float]:
+        """Non-destructive copy of the cumulative span totals (the
+        flight-recorder ring buffer stores one per step; ``drain``'s
+        per-boundary window is untouched)."""
+        return dict(self._cumulative)
+
     def take_excluded(self) -> float:
         """Non-productive seconds accumulated since the last take — the wall
         time ``ExpManager.step_timed`` must subtract from its throughput

@@ -125,6 +125,8 @@ def main() -> None:
             )
             compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):  # older jax: list of per-program dicts
+            cost = cost[0] if cost else {}
         logger.info("compile-only: train step compiled; flops=%s bytes=%s",
                     cost.get("flops"), cost.get("bytes accessed"))
         return

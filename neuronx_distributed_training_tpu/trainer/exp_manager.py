@@ -78,6 +78,12 @@ class ExpManager:
         self._last_step_time: Optional[float] = None
         self._metrics_file = self.log_dir / "metrics.jsonl"
         self._run_summary_file = self.log_dir / "run_summary.json"
+        # run_summary.json is a read-modify-write merge reached from the main
+        # thread (census, goodput teardown) AND, when the hang watchdog fires
+        # without aborting, from its timer thread (anomaly trail) — serialize
+        import threading
+
+        self._summary_lock = threading.Lock()
         # set by set_mfu_reference: (train-step FLOPs/token, chips, peak TF/s)
         self._mfu_ref: Optional[tuple[float, int, float]] = None
 
@@ -245,16 +251,17 @@ class ExpManager:
         """Merge ``section`` into ``run_summary.json`` (next to
         ``metrics.jsonl``): the one-shot facts of the run — compile census,
         goodput totals — that don't belong in the per-step stream."""
-        existing: dict[str, Any] = {}
-        try:
-            with open(self._run_summary_file) as f:
-                existing = json.load(f)
-        except (OSError, ValueError):
-            pass
-        existing.update(section)
-        with open(self._run_summary_file, "w") as f:
-            json.dump(existing, f, indent=1, sort_keys=True)
-            f.write("\n")
+        with self._summary_lock:
+            existing: dict[str, Any] = {}
+            try:
+                with open(self._run_summary_file) as f:
+                    existing = json.load(f)
+            except (OSError, ValueError):
+                pass
+            existing.update(section)
+            with open(self._run_summary_file, "w") as f:
+                json.dump(existing, f, indent=1, sort_keys=True)
+                f.write("\n")
 
     def log_metrics(self, step: int, metrics: dict[str, Any], *, force: bool = False) -> None:
         """Write scalars (TB + jsonl) every ``log_every_n_steps``.

@@ -54,6 +54,11 @@ from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
 logger = logging.getLogger(__name__)
 
+#: base seed of the loop's per-step RNG derivation — each train step runs
+#: with ``fold_in(PRNGKey(STEP_KEY_SEED), step)``, and the flight recorder's
+#: bundles cite the same recipe for offline replay (one source of truth)
+STEP_KEY_SEED = 0
+
 
 def parse_max_time(value: Any) -> Optional[float]:
     """``trainer.max_time`` -> seconds.  Accepts NeMo's ``DD:HH:MM:SS`` string
@@ -538,6 +543,15 @@ class Trainer:
         ema_cfg = (
             EMAConfig.from_config(ema_block) if ema_block.get("enable") else None
         )
+        # numerics flight recorder (telemetry.health): parsed here — before the
+        # optimizer state exists — because enabling it adds the health-counter
+        # subtree to opt_state (and therefore to its specs and checkpoints);
+        # ExpManager re-parses the same block for the host-side knobs
+        from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+
+        health_cfg = TelemetryConfig.from_config(
+            (cfg.get("exp_manager", {}) or {}).get("telemetry")
+        ).health
         abstract_params = jax.eval_shape(param_builder, init_key)
         if trainable is None and lora_block:
             # path-derived 0/1 scalars; reuses the one abstract trace
@@ -549,7 +563,7 @@ class Trainer:
         # gather-transpose scatter reaches the partitioner under manual pipe
         ospecs = opt_state_specs(
             abstract_params, pspecs, mesh, zero1=zero1, policy=policy,
-            ema=ema_cfg is not None,
+            ema=ema_cfg is not None, health=health_cfg.enabled,
         )
 
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
@@ -566,6 +580,7 @@ class Trainer:
             ema_cfg=ema_cfg,
             param_specs=pspecs,
             loss_and_grad_fn=pp_loss_and_grad,
+            health_cfg=health_cfg,
         )
         # NARROWED EMA workaround (round 3): donating an opt state that
         # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)
@@ -615,7 +630,8 @@ class Trainer:
         with mesh, shd.use_mesh(mesh):
             opt_state = jax.jit(
                 functools.partial(init_opt_state, policy=policy,
-                                  ema=ema_cfg is not None),
+                                  ema=ema_cfg is not None,
+                                  health=health_cfg.enabled),
                 out_shardings=shardings(ospecs),
             )(params)
 
@@ -873,10 +889,51 @@ class Trainer:
         """Restore newest checkpoint if one exists (reference ``resume_if_exists``)."""
         if self.checkpointer is None or self.checkpointer.latest_step() is None:
             return False
-        state = self.checkpointer.restore(
-            self.params, self.opt_state,
-            mesh=self.mesh, param_specs=self.param_specs, opt_specs=self.opt_specs,
-        )
+        try:
+            state = self.checkpointer.restore(
+                self.params, self.opt_state,
+                mesh=self.mesh, param_specs=self.param_specs,
+                opt_specs=self.opt_specs,
+            )
+        except Exception as orig:
+            if "health" not in self.opt_state:
+                raise
+            # enabling telemetry.health adds a subtree to the opt state, so a
+            # checkpoint written BEFORE the knob was turned on mismatches the
+            # template: retry without the health subtree and keep the freshly
+            # initialized (already correctly sharded) counters — an operator
+            # flipping health on must not lose their run.  A retry that fails
+            # too re-raises the ORIGINAL error (the real root cause), not the
+            # retry's.
+            logger.warning(
+                "resume: full restore failed (%s: %s); retrying without the "
+                "telemetry.health subtree in case the checkpoint predates it",
+                type(orig).__name__, orig,
+            )
+            stripped = {k: v for k, v in self.opt_state.items()
+                        if k != "health"}
+            stripped_specs = {k: v for k, v in self.opt_specs.items()
+                              if k != "health"}
+            try:
+                state = self.checkpointer.restore(
+                    self.params, stripped,
+                    mesh=self.mesh, param_specs=self.param_specs,
+                    opt_specs=stripped_specs,
+                )
+            except Exception:
+                raise orig
+            # fresh counters, but steps_seen MUST align with the restored
+            # trainer step: last_nonfinite_step derives from it, and a
+            # misaligned value would name the wrong step (and RNG recipe)
+            # in every future anomaly bundle
+            health = dict(self.opt_state["health"])
+            health["steps_seen"] = jnp.asarray(int(state.step), jnp.int32)
+            state.opt_state = dict(state.opt_state, health=health)
+            logger.info(
+                "resume: checkpoint predates telemetry.health — restored "
+                "without the health subtree, counters start fresh at step %d",
+                int(state.step),
+            )
         self.params = state.params
         self.opt_state = state.opt_state
         self.step = state.step
@@ -894,6 +951,8 @@ class Trainer:
         import time as _time
 
         from neuronx_distributed_training_tpu.telemetry import (
+            HangWatchdog,
+            HealthMonitor,
             RecompileDetector,
             SpanTimer,
         )
@@ -903,6 +962,30 @@ class Trainer:
         # timer is pure perf_counter bookkeeping, so either knob arms it
         spans = SpanTimer(enabled=tel.spans or tel.goodput)
         detector = RecompileDetector()
+        # numerics flight recorder: ring-buffers per-step forensic context
+        # (host references only — no device fetch on healthy steps) and
+        # applies the anomaly policy at the loop's existing sync boundaries
+        hc = tel.health
+        monitor = (
+            HealthMonitor(
+                hc, dump_dir=self.exp.log_dir, run_facts=self.run_facts,
+                write_run_summary=self.exp.write_run_summary,
+                rng_seed=STEP_KEY_SEED,
+            )
+            if hc.enabled else None
+        )
+        watchdog = (
+            HangWatchdog(hc.watchdog_timeout_seconds, monitor,
+                         abort=hc.watchdog_abort)
+            if monitor is not None and hc.watchdog_timeout_seconds > 0
+            else None
+        )
+        halted = False
+
+        def _sync_guard(what):
+            # arm the hung-device-sync watchdog around a blocking fetch
+            return (watchdog.guard(what, self.step) if watchdog is not None
+                    else contextlib.nullcontext())
 
         cfg_t = dict(self.cfg.get("trainer", {}) or {})
         val_interval = int(cfg_t.get("val_check_interval", 0) or 0)
@@ -935,7 +1018,14 @@ class Trainer:
         with spans.span("restart"):
             if self.pre_fit is not None:
                 self.pre_fit(self)
-            self.maybe_resume()
+            resumed = self.maybe_resume()
+            if resumed and monitor is not None and "health" in self.opt_state:
+                # align the boundary comparator with the RESTORED cumulative
+                # counter — otherwise the first boundary re-triggers the
+                # policy for an anomaly the previous incarnation handled
+                # (a permanent halt/restart loop under policy=halt)
+                monitor.seed_counters(
+                    int(self.opt_state["health"]["nonfinite_count"]))
         last_metrics: dict[str, float] = {}
         # background prefetch: slow fetch_rows (arrow page-in, mmap faults)
         # must not stall dispatch (the reference's MpDeviceLoader role);
@@ -955,7 +1045,8 @@ class Trainer:
                     self.exp.maybe_profile(self.step)
                     with spans.span("data_wait"):
                         batch = next(batches)
-                    key = jax.random.fold_in(jax.random.PRNGKey(0), self.step)
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(STEP_KEY_SEED), self.step)
                     if census_pending:
                         census_pending = False
                         self._compile_census(batch, key, spans)
@@ -983,6 +1074,15 @@ class Trainer:
                         self.params, self.opt_state, metrics = self.train_step(
                             self.params, self.opt_state, batch, key
                         )
+                    if monitor is not None:
+                        # host references only (device arrays stay unfetched);
+                        # the batch fingerprint is the retrace detector's —
+                        # one abstract-signature source of truth
+                        monitor.record(
+                            self.step, metrics,
+                            fingerprint=detector.signature("train_step"),
+                            spans=spans.snapshot() if spans.enabled else None,
+                        )
                     self.step += 1
                     if max_time is not None and stop_requested["reason"] is None:
                         if _time.monotonic() - t_start > max_time:
@@ -1005,8 +1105,26 @@ class Trainer:
                     last_fetch = self.step
                     # the boundary metric fetch is the loop's ONE host sync:
                     # any device time the host outran is absorbed here
-                    with spans.span("host_sync"):
+                    with spans.span("host_sync"), _sync_guard("host_sync"):
                         last_metrics = {k: float(v) for k, v in metrics.items()}
+                    if monitor is not None:
+                        # anomaly policy on the ALREADY-fetched scalars: a
+                        # healthy boundary costs one int compare; an anomaly
+                        # dumps the forensic bundle and applies the policy
+                        action = monitor.check_boundary(self.step, last_metrics)
+                        if action == "halt":
+                            # do NOT checkpoint: under halt the poisoned
+                            # update was applied, and auto-resume must find
+                            # the last GOOD checkpoint, not this state
+                            logger.error(
+                                "health policy=halt: non-finite step %d "
+                                "(bundle in %s) — stopping without a "
+                                "checkpoint; resume restores the last good "
+                                "save", int(last_metrics.get(
+                                    "health/last_nonfinite_step", -1)),
+                                self.exp.log_dir,
+                            )
+                            halted = True
                     # throughput window excludes validation/checkpoint/compile
                     # wall time (the spans tagged non-productive) so seq/s and
                     # throughput_peak reflect steady-state training only
@@ -1026,6 +1144,8 @@ class Trainer:
                         last_metrics.update(_device_memory_metrics(self.mesh))
                     self.exp.log_metrics(self.step, last_metrics)
 
+                    if halted:
+                        break
                     if val_interval and self.step % val_interval == 0 and self.eval_step:
                         with spans.span("validate"):
                             last_metrics["val_loss"] = self.validate(
@@ -1048,7 +1168,7 @@ class Trainer:
                                 self.save_checkpoint(last_metrics)
                         break
                 if (ck_every and self.checkpointer is not None
-                        and stop_requested["reason"] is None):
+                        and stop_requested["reason"] is None and not halted):
                     with spans.span("checkpoint"):
                         self.save_checkpoint(last_metrics)  # final save
         finally:
@@ -1087,15 +1207,27 @@ class Trainer:
 
         from neuronx_distributed_training_tpu.telemetry import compile_census
 
+        # deliberately NOT watchdog-guarded: a first compile legitimately runs
+        # minutes on TPU, and a sync-tuned timeout would false-abort it
         try:
             t0 = _time.perf_counter()
             compiled = self.train_step.lower(
                 self.params, self.opt_state, batch, key
             ).compile()
             dt = _time.perf_counter() - t0
-            # compile is non-productive wall time: goodput + the throughput
-            # window's exclusion both see it through the span
-            spans.add("compile", dt)
+        except Exception as e:  # noqa: BLE001 — census is best-effort
+            logger.warning(
+                "compile census failed; continuing with the jit path: %s", e
+            )
+            return
+        # the executable is in hand: swap it in BEFORE the fallible harvest/
+        # write below — a full run_summary.json disk error must not discard a
+        # multi-minute compile and force a second identical one
+        self.train_step = compiled
+        # compile is non-productive wall time: goodput + the throughput
+        # window's exclusion both see it through the span
+        spans.add("compile", dt)
+        try:
             census = compile_census(
                 compiled,
                 compile_seconds=dt,
@@ -1108,10 +1240,10 @@ class Trainer:
                 "compile census: %.1fs compile, collectives=%s",
                 dt, census.get("collectives"),
             )
-            self.train_step = compiled
-        except Exception as e:  # noqa: BLE001 — census is best-effort
+        except Exception as e:  # noqa: BLE001 — harvest is best-effort too
             logger.warning(
-                "compile census failed; continuing with the jit path: %s", e
+                "compile census harvest/write failed (the compiled step is "
+                "still in use): %s", e
             )
 
     def validate(self, limit_batches: int, detector=None) -> float:

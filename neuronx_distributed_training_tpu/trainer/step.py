@@ -58,6 +58,7 @@ def make_train_step(
     ema_cfg: Any = None,  # optim.adamw.EMAConfig; state must carry an "ema" tree
     param_specs: Any = None,  # pin grads to the param sharding (see below)
     loss_and_grad_fn: Optional[Callable] = None,  # manual-grad schedules (1F1B)
+    health_cfg: Any = None,  # telemetry.health.HealthConfig (numerics probes)
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``.
@@ -67,7 +68,22 @@ def make_train_step(
     computes its own gradients (the manual-vjp 1F1B pipeline).  Everything
     downstream of the gradients — grad-accum dtype, the param-sharding pin,
     the AdamW/ZeRO-1 update, metrics — is the SAME code path, so the
-    optimizer boundary is schedule-independent."""
+    optimizer boundary is schedule-independent.
+
+    ``health_cfg`` (enabled): the numerics flight recorder's in-graph probes —
+    per-layer-group grad norms (sharing the clipping norm's reduction pass),
+    loss finiteness, an ``updates_finite`` flag, cumulative anomaly counters
+    threaded through ``opt_state["health"]`` (which ``init_opt_state(...,
+    health=True)`` must have created), and — under ``policy: skip_update`` —
+    the in-graph suppression of a non-finite update.  All of it rides the one
+    jitted executable; the host sees the results only at the boundary metric
+    fetch it already performs."""
+    health = health_cfg if (health_cfg is not None
+                            and getattr(health_cfg, "enabled", False)) else None
+    if health is not None:
+        from neuronx_distributed_training_tpu.telemetry.health import (
+            grad_group_of,
+        )
 
     def grad_one_microbatch(params, mb, step_key):
         def scalar_loss(p):
@@ -136,6 +152,10 @@ def make_train_step(
         new_params, new_opt_state, opt_metrics = adamw_update(
             params, grads, opt_state, lr, opt_cfg, policy,
             trainable_mask=trainable_mask, ema_cfg=ema_cfg,
+            grad_group_fn=grad_group_of if health is not None else None,
+            skip_nonfinite=(health is not None
+                            and health.policy == "skip_update"),
+            extra_finite=(jnp.isfinite(loss) if health is not None else None),
         )
         metrics = {
             "loss": loss,
@@ -143,6 +163,38 @@ def make_train_step(
             "grad_norm": opt_metrics["grad_norm"],
         }
         metrics.update({k: v for k, v in aux.items() if k not in metrics})
+        if health is not None:
+            ok = opt_metrics["updates_finite"]
+            bad = jnp.logical_not(ok).astype(jnp.int32)
+            prev = opt_state["health"]
+            # steps_seen counts train-step INVOCATIONS (unlike opt step, which
+            # freezes on a skipped update) — steps_seen - 1 is the 0-based
+            # trainer step just computed, the id the forensic bundle names
+            seen = prev["steps_seen"] + 1
+            hstate = {
+                "steps_seen": seen,
+                "nonfinite_count": prev["nonfinite_count"] + bad,
+                "skipped_count": prev["skipped_count"] + (
+                    bad if health.policy == "skip_update"
+                    else jnp.zeros((), jnp.int32)),
+                "last_nonfinite_step": jnp.where(
+                    bad == 1, seen - 1, prev["last_nonfinite_step"]),
+            }
+            new_opt_state["health"] = hstate
+            metrics["health/updates_finite"] = ok.astype(jnp.float32)
+            metrics["health/loss_finite"] = jnp.isfinite(loss).astype(
+                jnp.float32)
+            metrics["health/nonfinite_count"] = hstate["nonfinite_count"]
+            metrics["health/skipped_count"] = hstate["skipped_count"]
+            metrics["health/last_nonfinite_step"] = (
+                hstate["last_nonfinite_step"])
+            for g, n in opt_metrics.get("group_norms", {}).items():
+                metrics[f"health/grad_norm/{g}"] = n
+            if health.param_norm:
+                # post-update param norm: the host-side monitor diffs ring
+                # entries to surface drift (a slow divergence the per-step
+                # grad norm alone doesn't show)
+                metrics["health/param_norm"] = global_norm(new_params)
         if log_param_norm:
             # reference log_parameter_norm (base.py:397-452): TP/CP/PP-group
             # all-reduced norm — here a plain global norm (params are one

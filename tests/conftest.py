@@ -21,6 +21,29 @@ jax.config.update("jax_threefry_partitionable", True)
 import pytest  # noqa: E402
 
 
+def has_orbax_preservation() -> bool:
+    """True when this image's orbax ships ``checkpoint_managers.
+    preservation_policy`` — the retention API ``Checkpointer.__init__``
+    builds on (``checkpoint/manager.py``).  Older orbax releases lack the
+    module (and their ``CheckpointManagerOptions`` rejects the
+    ``preservation_policy`` kwarg), so EVERY Checkpointer construction fails
+    there; tests that construct one carry ``requires_orbax_preservation``."""
+    try:
+        import orbax.checkpoint.checkpoint_managers.preservation_policy  # noqa: F401
+    except Exception:  # noqa: BLE001 — missing module OR import-time error
+        return False
+    return True
+
+
+#: precise environment guard: skip (not fail) Checkpointer-constructing tests
+#: on images whose orbax predates the preservation-policy retention API
+requires_orbax_preservation = pytest.mark.skipif(
+    not has_orbax_preservation(),
+    reason="orbax-checkpoint too old: no checkpoint_managers."
+           "preservation_policy (Checkpointer retention API)",
+)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -9,7 +9,12 @@ example-based tests hardcode around.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# collect (and cleanly skip) on images without the hypothesis extra instead
+# of erroring the whole tier-1 collection
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 import jax.numpy as jnp
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Render a numerics-anomaly forensic bundle as a terminal table.
+
+The sibling of ``metrics_report.py`` for the flight recorder's output: given
+an ``anomaly_<step>/`` bundle (or a run dir, in which case the newest bundle
+is picked), prints the trigger summary, the ring-buffered per-step health
+trail, and the per-layer-group grad norms of the offending step — the
+"what blew up, where, and what led up to it" view before reaching for replay.
+
+    python tools/anomaly_report.py nxdt_experiments/run/version_0
+    python tools/anomaly_report.py path/to/anomaly_00000042
+
+Pure stdlib on purpose: it must run on a login node with nothing installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+def _fmt(v) -> str:
+    if not isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, float) and math.isnan(v):
+        return "nan"
+    if isinstance(v, float) and math.isinf(v):
+        return "inf" if v > 0 else "-inf"
+    a = abs(v)
+    if a != 0 and (a >= 1e6 or a < 1e-3):
+        return f"{v:.3e}"
+    if float(v).is_integer():
+        return f"{v:,.0f}"
+    return f"{v:.4f}"
+
+
+def _table(rows: list[tuple[str, ...]], header: tuple[str, ...]) -> str:
+    widths = [max(len(str(r[i])) for r in [header, *rows])
+              for i in range(len(header))]
+
+    def fmt_row(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt_row(header), sep, *(fmt_row(r) for r in rows)])
+
+
+def find_bundle(path: str) -> str | None:
+    """``path`` is a bundle dir, or a run dir holding ``anomaly_*``/``hang_*``
+    bundles (newest picked)."""
+    if os.path.exists(os.path.join(path, "anomaly.json")):
+        return path
+    if not os.path.isdir(path):
+        return None
+
+    def step_of(name: str) -> int:
+        try:
+            return int(name.rsplit("_", 1)[1])
+        except (IndexError, ValueError):
+            return -1
+
+    # newest by STEP, not by name — lexicographic order would rank every
+    # hang_* bundle above every anomaly_* bundle ("h" > "a")
+    bundles = sorted(
+        (e for e in os.listdir(path)
+         if (e.startswith("anomaly_") or e.startswith("hang_"))
+         and os.path.exists(os.path.join(path, e, "anomaly.json"))),
+        key=lambda e: (step_of(e), e),
+    )
+    return os.path.join(path, bundles[-1]) if bundles else None
+
+
+def summary_section(summary: dict) -> str:
+    lines = [f"{summary.get('kind', 'anomaly')} bundle — step "
+             f"{summary.get('anomaly_step')}"]
+    for key in ("policy", "trigger_step", "hung_operation",
+                "watchdog_timeout_seconds", "ring_buffer_steps"):
+        if summary.get(key) is not None:
+            lines.append(f"  {key:<24} {_fmt(summary[key])}")
+    rng = summary.get("rng") or {}
+    if rng:
+        lines.append(f"  rng                      "
+                     f"fold_in(PRNGKey({rng.get('seed', 0)}), "
+                     f"{rng.get('fold_in')})")
+    for key in ("model_family", "pipeline_schedule", "n_chips", "seq_len",
+                "global_batch_size"):
+        v = (summary.get("run_facts") or {}).get(key)
+        if v is not None:
+            lines.append(f"  {key:<24} {_fmt(v)}")
+    if summary.get("compile_census"):
+        lines.append(f"  compile census           {summary['compile_census']}")
+    return "\n".join(lines)
+
+
+def ring_section(ring: list[dict]) -> str:
+    if not ring:
+        return ""
+    cols = ("loss", "grad_norm", "health/updates_finite",
+            "health/param_norm", "health/nonfinite_count")
+    rows = []
+    prev_pnorm = None
+    for e in ring:
+        m = e.get("metrics") or {}
+        pnorm = m.get("health/param_norm")
+        drift = ""
+        if isinstance(pnorm, (int, float)) and isinstance(prev_pnorm, (int, float)):
+            drift = _fmt(pnorm - prev_pnorm)
+        prev_pnorm = pnorm if isinstance(pnorm, (int, float)) else prev_pnorm
+        rows.append((str(e.get("step")),
+                     *(_fmt(m[c]) if c in m else "-" for c in cols),
+                     drift))
+    return ("\nring buffer (oldest first)\n"
+            + _table(rows, ("step", "loss", "grad_norm", "finite",
+                            "param_norm", "nonfinite", "pnorm_drift")))
+
+
+def group_norms_section(ring: list[dict], anomaly_step: int) -> str:
+    entry = next((e for e in ring if e.get("step") == anomaly_step),
+                 ring[-1] if ring else None)
+    if not entry:
+        return ""
+    prefix = "health/grad_norm/"
+    groups = {k[len(prefix):]: v for k, v in (entry.get("metrics") or {}).items()
+              if k.startswith(prefix)}
+    if not groups:
+        return ""
+    rows = [(g, _fmt(v)) for g, v in sorted(groups.items())]
+    return (f"\nper-group grad norms (step {entry.get('step')})\n"
+            + _table(rows, ("group", "grad_norm")))
+
+
+def fingerprint_section(ring: list[dict], anomaly_step: int) -> str:
+    entry = next((e for e in ring if e.get("step") == anomaly_step), None)
+    fp = (entry or {}).get("fingerprint")
+    if not fp:
+        return ""
+    rows = [(k, v) for k, v in sorted(fp.items())]
+    return (f"\nbatch fingerprint (step {anomaly_step})\n"
+            + _table(rows, ("leaf", "dtype[shape]")))
+
+
+def render(bundle_dir: str) -> str:
+    with open(os.path.join(bundle_dir, "anomaly.json")) as f:
+        summary = json.load(f)
+    ring: list[dict] = []
+    ring_path = os.path.join(bundle_dir, "ring.json")
+    if os.path.exists(ring_path):
+        with open(ring_path) as f:
+            ring = json.load(f)
+    step = int(summary.get("anomaly_step", -1))
+    parts = [summary_section(summary), ring_section(ring),
+             group_norms_section(ring, step), fingerprint_section(ring, step)]
+    stacks = os.path.join(bundle_dir, "stacks.txt")
+    if os.path.exists(stacks):
+        parts.append(f"\npython stacks: {stacks}")
+    return "\n".join(p for p in parts if p)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="anomaly_<step>/ bundle dir, or a run dir "
+                                 "(newest bundle picked)")
+    args = ap.parse_args(argv)
+    bundle = find_bundle(args.path)
+    if bundle is None:
+        print(f"anomaly_report: no forensic bundle at {args.path}",
+              file=sys.stderr)
+        return 2
+    print(render(bundle))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -101,6 +101,28 @@ def goodput_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def anomalies_section(summary: dict) -> str:
+    """Flight-recorder trail: one line per forensic bundle the run dumped
+    (render a bundle itself with ``tools/anomaly_report.py``)."""
+    anomalies = summary.get("anomalies") or []
+    if not anomalies:
+        return ""
+    lines = ["", f"anomalies ({len(anomalies)} forensic bundle"
+                 f"{'s' if len(anomalies) != 1 else ''} — "
+                 f"tools/anomaly_report.py renders one)"]
+    for a in anomalies:
+        # tolerate partial/malformed entries (older schema / hand edits) —
+        # a bad trail line must not abort the whole report
+        if not isinstance(a, dict):
+            lines.append(f"  (unreadable entry: {a!r})")
+            continue
+        step = str(a.get("step", "?"))
+        policy = str(a.get("policy", "?"))
+        lines.append(f"  step {step:<8} policy={policy:<18} "
+                     f"{a.get('bundle', '?')}")
+    return "\n".join(lines)
+
+
 def census_section(summary: dict) -> str:
     lines: list[str] = []
     if "compile_seconds" in summary:
@@ -148,6 +170,7 @@ def render(metrics_path: str | None, summary_path: str | None,
             parts.append(f"unreadable {summary_path}: {e}")
     if summary:
         parts.append(goodput_section(summary))
+        parts.append(anomalies_section(summary))
         parts.append(census_section(summary))
     return "\n".join(p for p in parts if p)
 
