@@ -421,11 +421,35 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         from neuronx_distributed_training_tpu.telemetry import compile_census
 
         t_compile = time.perf_counter()
-        compiled = jstep.lower(params, opt_state, batch, key).compile()
+        lowered = jstep.lower(params, opt_state, batch, key)
+        compiled = lowered.compile()
         compile_seconds = time.perf_counter() - t_compile
         census = compile_census(compiled, compile_seconds=compile_seconds)
         log(f"bench: compiled in {compile_seconds:.1f}s "
             f"collectives={census.get('collectives')}")
+
+        # pre-flight graph audit of the very executable being measured
+        # (analysis.graph_audit): a bench number from a step that silently
+        # lost donation (or grew a stray collective) is not comparable to
+        # the recorded baselines — the verdict rides the JSON line
+        audit_summary = None
+        try:
+            from neuronx_distributed_training_tpu.analysis.graph_audit import (
+                AuditContext, audit_executable,
+            )
+
+            ctx = AuditContext(
+                cfg={"distributed_strategy": {"zero1": True}}, mesh=mesh,
+                policy=policy, model_cfg=cfg,
+                sched={"global_batch_size": mbs, "micro_batch_size": mbs},
+                donate=True, params_tree=params, opt_tree=opt_state,
+                pspecs=pspecs, ospecs=ospecs,
+            )
+            audit = audit_executable(
+                ctx, compiled, lowered, log=lambda m: log(f"bench: {m}"))
+            audit_summary = audit.summary()
+        except Exception as e:  # noqa: BLE001 — audit must never fail the bench
+            log(f"bench: graph audit unavailable: {e}")
 
         t_warm = time.perf_counter()
         for _ in range(warmup):
@@ -485,6 +509,9 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
         "nonfinite_steps": nonfinite_steps,
         "skipped_updates": skipped_updates,
         "final_grad_norm": json_float(final_grad_norm),
+        # pre-flight graph-audit verdict (rule hits by severity + donation
+        # coverage) for the measured executable
+        "graph_audit": audit_summary,
     }
 
 
@@ -663,12 +690,15 @@ def main() -> None:
         "nonfinite_steps": r.get("nonfinite_steps"),
         "skipped_updates": r.get("skipped_updates"),
         "final_grad_norm": r.get("final_grad_norm"),
+        # headline regime's static graph-audit verdict (analysis.graph_audit)
+        "graph_audit": r.get("graph_audit"),
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }
     for name, res in results.items():
         payload[f"mfu_{name}"] = round(100 * res["mfu"], 2)
         payload[f"layers_{name}"] = res["num_layers"]
+        payload[f"graph_audit_{name}"] = res.get("graph_audit")
     if errors:
         payload["regime_errors"] = errors
     if backend_err:

@@ -1,0 +1,31 @@
+"""Pre-flight static auditor — config→HLO contract checks + a JAX source lint.
+
+NxDT's promise is that a YAML config reliably becomes a correctly-parallelized
+training job.  On TPU the failure mode is silent: a mis-specified
+PartitionSpec, a lost buffer donation, or a stray host sync costs memory and
+step time without ever erroring.  Both halves of that promise are *statically
+checkable* before a device-hour is spent (DeepCompile, arXiv:2504.09983;
+GShard, arXiv:2004.13336):
+
+- ``graph_audit`` AOT-lowers the train step for any config on abstract inputs
+  (zero arrays materialized, no data files opened — it builds on
+  ``trainer.loop.assemble_step_program``) and checks the compiled artifact
+  against the config's declared contracts: donation actually aliased, the
+  collective census the parallelism config implies, no oversized replicated
+  intermediates, no f32 matmuls under bf16 regimes;
+- ``jaxlint`` is an AST pass over the package flagging JAX pitfalls in jitted
+  paths (hidden host syncs, tracer branching, wall-clock reads, PRNG key
+  reuse, donated-buffer reuse) with ``# jaxlint: disable=RULE`` suppressions
+  and a committed ratchet baseline;
+- ``tools/preflight_audit.py`` is the CLI gate over both.
+
+Rule catalogue: ``docs/static_analysis.md``.
+"""
+
+from neuronx_distributed_training_tpu.analysis.report import (
+    SEVERITIES,
+    AuditReport,
+    Finding,
+)
+
+__all__ = ["AuditReport", "Finding", "SEVERITIES"]

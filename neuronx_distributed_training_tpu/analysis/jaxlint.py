@@ -1,0 +1,545 @@
+"""Layer 2 of the pre-flight auditor: an AST lint for JAX pitfalls.
+
+The graph audit sees what the compiler produced; this pass sees what the
+*source* is about to feed it.  It walks the package's Python modules and
+flags the pitfalls that cost memory or step time without ever erroring —
+each only in the scope where it is actually a pitfall:
+
+- **JL101 hidden host sync** (graph scope): ``.item()`` / ``.tolist()`` on
+  anything, ``np.asarray``/``np.array`` applied to a traced function
+  parameter, ``float()``/``int()``/``bool()`` wrapped directly around a
+  ``jnp``/``jax`` call.  Inside a jitted path each of these blocks dispatch
+  on a device round-trip (or silently constant-folds a tracer).
+- **JL102 tracer branch** (graph scope): ``if``/``while`` whose test is a
+  ``jnp``/``jax`` call (``if jnp.any(mask):``) — Python control flow cannot
+  branch on a tracer; this either crashes late or retraces per value.
+- **JL103 wall clock** (graph scope): ``time.time()``/``perf_counter()``/
+  ``datetime.now()`` inside a step function traces to a constant — the
+  timestamp of tracing, not of execution.
+- **JL104 PRNG key reuse** (all scopes): the same key variable fed to two
+  ``jax.random`` consumers without a ``split``/``fold_in`` reassignment in
+  between — correlated randomness, the classic silent statistics bug.
+- **JL105 donated-buffer reuse** (all scopes): reading a variable again
+  after passing it to a function built with ``donate_argnums``/
+  ``jit_train_step`` without rebinding it — the buffer may already be
+  aliased over.
+
+Scope model: modules whose package path matches ``GRAPH_MODULES`` are graph
+scope (their code is overwhelmingly traced); any function wrapped in a jax
+transform (``jax.jit``/``jax.grad``/``shard_map``/``lax.scan`` ...) is graph
+scope regardless of module; a ``# jaxlint: host`` (or ``graph``) comment in
+a file's first 5 lines overrides.  Suppress a single finding with
+``# jaxlint: disable=RULE`` on the offending line.  ``baseline.json`` is the
+committed ratchet: pre-existing findings pass, NEW findings fail, and a
+baseline entry that no longer matches anything is STALE and fails too (the
+baseline only shrinks).  See ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Optional
+
+from neuronx_distributed_training_tpu.analysis.report import (
+    AuditReport,
+    Finding,
+)
+
+#: package-relative glob-ish prefixes whose modules are graph scope: their
+#: functions run under jit/shard_map in the trained program
+GRAPH_MODULES = (
+    "models/", "ops/", "optim/", "alignment/", "peft/",
+    "parallel/pipeline", "parallel/ring_attention", "parallel/ulysses",
+    "trainer/step",
+)
+
+#: jax transforms whose function argument becomes traced code
+_TRANSFORMS = {
+    "jit", "grad", "value_and_grad", "vjp", "jvp", "vmap", "pmap",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "shard_map",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associated_scan",
+    "eval_shape", "linearize",
+}
+
+#: jax.random constructors (NOT consumers — these mint keys)
+_KEY_MAKERS = {"PRNGKey", "key", "wrap_key_data", "clone"}
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Z0-9, ]+)")
+_MODE_RE = re.compile(r"#\s*jaxlint:\s*(graph|host)\b")
+
+_DONATING_BUILDERS = {"jit_train_step"}  # package-local donating factories
+
+
+def _dotted(node: ast.AST) -> str:
+    """``jax.random.normal`` -> "jax.random.normal"; non-dotted -> ""."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jaxish(call: ast.AST) -> bool:
+    """A call spelled through a jax/jnp/lax namespace (the linter's cheap
+    "this produces/handles a traced value" signal)."""
+    if not isinstance(call, ast.Call):
+        return False
+    head = _dotted(call.func).split(".")[0]
+    return head in ("jnp", "jax", "lax")
+
+
+@dataclasses.dataclass
+class LintContext:
+    path: Path            # file being linted
+    rel: str              # package-relative posix path
+    source_lines: list[str]
+    graph_default: bool   # module-level scope
+    report: AuditReport = dataclasses.field(default_factory=AuditReport)
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        def match(ln: int) -> bool:
+            if not 1 <= ln <= len(self.source_lines):
+                return False
+            m = _SUPPRESS_RE.search(self.source_lines[ln - 1])
+            return bool(m and rule in
+                        {r.strip() for r in m.group(1).split(",")})
+
+        if match(lineno):
+            return True
+        # a standalone `# jaxlint: disable=...` comment line covers the NEXT
+        # line; an inline disable on the previous line covers only itself
+        prev = (self.source_lines[lineno - 2].strip()
+                if lineno >= 2 and lineno - 2 < len(self.source_lines) else "")
+        return prev.startswith("#") and match(lineno - 1)
+
+    def add(self, rule: str, severity: str, message: str, node: ast.AST,
+            hint: str = "") -> None:
+        lineno = getattr(node, "lineno", 0)
+        if self.suppressed(lineno, rule):
+            return
+        snippet = ""
+        if 1 <= lineno <= len(self.source_lines):
+            snippet = self.source_lines[lineno - 1].strip()[:120]
+        self.report.findings.append(Finding(
+            rule=rule, severity=severity,
+            message=f"{message}: `{snippet}`" if snippet else message,
+            location=f"{self.rel}:{lineno}",
+            hint=hint,
+        ))
+
+
+def module_is_graph(rel: str, source: str) -> bool:
+    head = "\n".join(source.splitlines()[:5])
+    m = _MODE_RE.search(head)
+    if m:
+        return m.group(1) == "graph"
+    return any(rel.startswith(g) or f"/{g}" in rel for g in GRAPH_MODULES)
+
+
+# --------------------------------------------------------------------------
+# per-function pass
+# --------------------------------------------------------------------------
+
+
+class _FunctionLinter:
+    """Lints one function body.  ``graph`` marks traced scope (JL101-103)."""
+
+    def __init__(self, ctx: LintContext, fn: ast.AST, graph: bool):
+        self.ctx = ctx
+        self.fn = fn
+        self.graph = graph
+        self.params = {
+            a.arg for a in (
+                list(fn.args.posonlyargs) + list(fn.args.args)
+                + list(fn.args.kwonlyargs)
+                + ([fn.args.vararg] if fn.args.vararg else [])
+                + ([fn.args.kwarg] if fn.args.kwarg else [])
+            )
+        } if hasattr(fn, "args") else set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _walk_shallow(self, node: ast.AST) -> Iterable[ast.AST]:
+        """Walk without descending into nested function definitions (they
+        are linted separately, with their own scope)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    # -- rules -------------------------------------------------------------
+
+    def lint(self) -> None:
+        if self.graph:
+            self._lint_host_sync()
+            self._lint_tracer_branch()
+            self._lint_wall_clock()
+        self._lint_key_reuse()
+
+    def _lint_host_sync(self) -> None:
+        for n in self._walk_shallow(self.fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _dotted(n.func)
+            # x.item() / x.tolist() — device fetch, whatever x is
+            if isinstance(n.func, ast.Attribute) and n.func.attr in (
+                    "item", "tolist") and not name.startswith(("np.", "math.")):
+                self.ctx.add(
+                    "JL101", "warn",
+                    "host sync in a jitted path (device fetch)", n,
+                    hint="return the value from the jitted fn and fetch it "
+                         "at a logging boundary instead",
+                )
+            # np.asarray/np.array on a traced parameter
+            elif name in ("np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array") and n.args:
+                a = n.args[0]
+                if (isinstance(a, ast.Name) and a.id in self.params) \
+                        or _is_jaxish(a):
+                    self.ctx.add(
+                        "JL101", "warn",
+                        "np.asarray on a traced value forces a device "
+                        "round-trip inside the graph", n,
+                        hint="keep the computation in jnp; convert on host "
+                             "after the fetch",
+                    )
+            # float(jnp.sum(x)) — blocks on the reduction
+            elif name in ("float", "int", "bool") and n.args \
+                    and _is_jaxish(n.args[0]):
+                self.ctx.add(
+                    "JL101", "warn",
+                    f"{name}() around a jax call blocks dispatch on a "
+                    f"device round-trip", n,
+                    hint="keep it a jnp scalar in-graph; cast with "
+                         ".astype() if a dtype is needed",
+                )
+
+    def _lint_tracer_branch(self) -> None:
+        for n in self._walk_shallow(self.fn):
+            if isinstance(n, (ast.If, ast.While)) and _test_is_traced(n.test):
+                self.ctx.add(
+                    "JL102", "warn",
+                    "Python control flow on a traced value", n,
+                    hint="use jnp.where / lax.cond / lax.select — Python "
+                         "`if` freezes the branch at trace time (or raises "
+                         "ConcretizationTypeError)",
+                )
+
+    def _lint_wall_clock(self) -> None:
+        for n in self._walk_shallow(self.fn):
+            if isinstance(n, ast.Call) and _dotted(n.func) in (
+                "time.time", "time.perf_counter", "time.monotonic",
+                "time.process_time", "datetime.now", "datetime.datetime.now",
+                "datetime.utcnow", "datetime.datetime.utcnow",
+            ):
+                self.ctx.add(
+                    "JL103", "warn",
+                    "wall-clock read inside a jitted path traces to a "
+                    "constant (the time of TRACING, not execution)", n,
+                    hint="measure on host around the dispatch, or thread a "
+                         "step counter through the graph",
+                )
+
+    def _lint_key_reuse(self) -> None:
+        """Same key Name consumed by >= 2 jax.random calls with no
+        reassignment between — statement-ordered scan of this body.
+        ``if``/``try`` branches are mutually exclusive at runtime, so the
+        use-timeline FORKS there and re-merges after (one consumer per
+        branch is not reuse)."""
+
+        def shallow(stmt: ast.AST) -> Iterable[ast.AST]:
+            # nested defs are linted as their own functions; descending here
+            # would merge sibling closures' key uses into one timeline
+            stack = [stmt]
+            while stack:
+                n = stack.pop()
+                yield n
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+
+        def check_uses(node: ast.AST, used: dict[str, ast.Call]) -> None:
+            for n in shallow(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = _dotted(n.func)
+                if not name.startswith(("jax.random.", "jrandom.", "jr.")):
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                if tail in _KEY_MAKERS or tail in ("split", "fold_in"):
+                    # split/fold_in DERIVE keys: feeding one base key to
+                    # many fold_in(key, i) calls is the idiom, not the bug
+                    continue
+                if not n.args or not isinstance(n.args[0], ast.Name):
+                    continue
+                key = n.args[0].id
+                if key in used:
+                    self.ctx.add(
+                        "JL104", "warn",
+                        f"PRNG key `{key}` reused by a second "
+                        f"jax.random sampler without split/fold_in",
+                        n,
+                        hint="derive fresh keys: `k1, k2 = "
+                             "jax.random.split(key)` (reusing a key "
+                             "correlates the two draws)",
+                    )
+                else:
+                    used[key] = n
+
+        def clear_rebinds(node: ast.AST, used: dict[str, ast.Call]) -> None:
+            for n in shallow(node):
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    tgts = (n.targets if isinstance(n, ast.Assign)
+                            else [n.target])
+                    for t in tgts:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                used.pop(leaf.id, None)
+                elif isinstance(n, ast.For):
+                    for leaf in ast.walk(n.target):
+                        if isinstance(leaf, ast.Name):
+                            used.pop(leaf.id, None)
+
+        def scan(body: list[ast.stmt], used: dict[str, ast.Call]) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.If):
+                    check_uses(stmt.test, used)
+                    u1, u2 = dict(used), dict(used)
+                    scan(stmt.body, u1)
+                    scan(stmt.orelse, u2)
+                    used.clear()
+                    used.update({**u1, **u2})
+                    continue
+                if isinstance(stmt, ast.Try):
+                    scan(stmt.body, used)
+                    u_h = dict(used)
+                    for h in stmt.handlers:
+                        scan(h.body, u_h)
+                    used.update(u_h)
+                    scan(stmt.orelse, used)
+                    scan(stmt.finalbody, used)
+                    continue
+                # simple (or loop/with) statement: uses first (the RHS
+                # evaluates before targets bind), then rebinds clear
+                check_uses(stmt, used)
+                clear_rebinds(stmt, used)
+
+        body = getattr(self.fn, "body", [])
+        scan(body if isinstance(body, list) else [], {})
+
+
+def _test_is_traced(test: ast.AST) -> bool:
+    """True when an if/while test is visibly a jax value: a jnp/jax call, a
+    comparison with one, or a boolean combination thereof."""
+    #: metadata queries that return Python values even on tracers
+    static_tails = {"ndim", "isinstance", "len", "dtype", "issubdtype",
+                    "result_type", "promote_types", "can_cast", "shape",
+                    "size", "isdtype"}
+
+    def _static(call: ast.AST) -> bool:
+        return (_dotted(call.func).rsplit(".", 1)[-1]  # type: ignore
+                in static_tails)
+
+    if _is_jaxish(test):
+        # jnp.any(...) etc. — except explicitly-static metadata queries
+        return not _static(test)
+    if isinstance(test, ast.Compare):
+        sides = [test.left, *test.comparators]
+        if any(_is_jaxish(s) and _static(s) for s in sides):
+            return False
+        return any(_is_jaxish(c) for c in sides)
+    if isinstance(test, ast.BoolOp):
+        return any(_test_is_traced(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp):
+        return _test_is_traced(test.operand)
+    return False
+
+
+# --------------------------------------------------------------------------
+# module pass: scope resolution + donated-buffer rule
+# --------------------------------------------------------------------------
+
+
+def _transform_wrapped(tree: ast.Module) -> set[str]:
+    """Function names passed to (or decorated with) a jax transform anywhere
+    in the module — graph scope even inside host modules."""
+    graph: set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                head = dec.func if isinstance(dec, ast.Call) else dec
+                if _dotted(head).rsplit(".", 1)[-1] in _TRANSFORMS:
+                    graph.add(n.name)
+        if isinstance(n, ast.Call):
+            tail = _dotted(n.func).rsplit(".", 1)[-1]
+            if tail in _TRANSFORMS:
+                for a in n.args[:1]:
+                    if isinstance(a, ast.Name):
+                        graph.add(a.id)
+    return graph
+
+
+def _lint_donated_reuse(ctx: LintContext, tree: ast.Module) -> None:
+    """JL105: donated callable's argument read again afterwards, per
+    function body, source order."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        donating: set[str] = set()
+        donated_vars: dict[str, int] = {}  # name -> line of the donation
+        for stmt in fn.body if isinstance(fn.body, list) else []:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    callee = _dotted(n.value.func)
+                    is_donating = callee.rsplit(".", 1)[-1] in \
+                        _DONATING_BUILDERS or (
+                            callee.rsplit(".", 1)[-1] == "jit"
+                            and any(kw.arg in ("donate_argnums", "donate")
+                                    for kw in n.value.keywords))
+                    if is_donating:
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                donating.add(t.id)
+            # a call to a donating fn marks its Name args donated
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                        and n.func.id in donating:
+                    for a in n.args:
+                        if isinstance(a, ast.Name):
+                            donated_vars[a.id] = n.lineno
+            # reads of donated names AFTER the donating call
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in donated_vars \
+                        and n.lineno > donated_vars[n.id]:
+                    ctx.add(
+                        "JL105", "warn",
+                        f"`{n.id}` read after being passed to a donating "
+                        f"call (its buffer may already be reused)", n,
+                        hint="rebind the result over the donated name "
+                             "(`params, ... = step(params, ...)`) before "
+                             "any further use",
+                    )
+                    donated_vars.pop(n.id)
+            # rebinds clear donation
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    tgts = (n.targets if isinstance(n, ast.Assign)
+                            else [n.target])
+                    for t in tgts:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                donated_vars.pop(leaf.id, None)
+
+
+def lint_file(path: Path, package_root: Path) -> AuditReport:
+    source = path.read_text()
+    rel = path.relative_to(package_root).as_posix()
+    ctx = LintContext(
+        path=path, rel=rel, source_lines=source.splitlines(),
+        graph_default=module_is_graph(rel, source),
+    )
+    ctx.report.config = rel
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        ctx.report.add("JL000", "error", f"unparseable: {e}",
+                       location=f"{rel}:{e.lineno or 0}")
+        return ctx.report
+    wrapped = _transform_wrapped(tree)
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            graph = ctx.graph_default or fn.name in wrapped
+            _FunctionLinter(ctx, fn, graph).lint()
+    _lint_donated_reuse(ctx, tree)
+    return ctx.report
+
+
+def lint_package(
+    root: Optional[Path] = None,
+    *,
+    files: Optional[list[Path]] = None,
+) -> AuditReport:
+    """Lint the whole package (or an explicit file list).  ``root`` defaults
+    to the installed ``neuronx_distributed_training_tpu`` package dir."""
+    if root is None:
+        root = Path(__file__).resolve().parent.parent
+    report = AuditReport(config=str(root))
+    targets = files if files is not None else sorted(root.rglob("*.py"))
+    for f in targets:
+        if "analysis" in f.relative_to(root).parts[:1]:
+            # the linter's own fixtures/baselines stay out of scope; the
+            # analysis package is host-side tooling by definition
+            continue
+        sub = lint_file(f, root)
+        report.findings.extend(sub.findings)
+    report.stats["files_linted"] = len(targets)
+    return report
+
+
+# --------------------------------------------------------------------------
+# ratchet baseline
+# --------------------------------------------------------------------------
+
+BASELINE_PATH = Path(__file__).resolve().parent / "jaxlint_baseline.json"
+
+
+def fingerprint(f: Finding) -> str:
+    """Line-number-free identity: rule + file + the code snippet from the
+    message (stable across unrelated edits above the finding)."""
+    file = f.location.rsplit(":", 1)[0]
+    snippet = f.message.split("`")[1] if "`" in f.message else ""
+    return f"{f.rule}|{file}|{snippet}"
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> list[str]:
+    if not path.exists():
+        return []
+    return list(json.loads(path.read_text()).get("findings", []))
+
+
+def write_baseline(report: AuditReport, path: Path = BASELINE_PATH) -> None:
+    path.write_text(json.dumps(
+        {"comment": "jaxlint ratchet baseline — may only shrink; "
+                    "regenerate with tools/preflight_audit.py "
+                    "--update-baseline",
+         "findings": sorted(fingerprint(f) for f in report.findings)},
+        indent=1,
+    ) + "\n")
+
+
+def apply_ratchet(report: AuditReport,
+                  baseline: list[str]) -> tuple[AuditReport, list[str]]:
+    """Split lint findings against the baseline.
+
+    Returns ``(fresh_report, stale_entries)``: ``fresh_report`` holds only
+    NEW findings (escalated to error — the ratchet's fail condition), and
+    ``stale_entries`` are baseline lines that matched nothing (the code got
+    cleaner; the baseline must shrink to match, so staleness fails too)."""
+    remaining = list(baseline)
+    fresh = AuditReport(config=report.config, stats=dict(report.stats))
+    for f in report.findings:
+        fp = fingerprint(f)
+        if fp in remaining:
+            remaining.remove(fp)
+        else:
+            fresh.findings.append(Finding(
+                rule=f.rule, severity="error",
+                message=f.message, location=f.location,
+                hint=f.hint or "new finding (not in the committed baseline): "
+                               "fix it or suppress with # jaxlint: disable=",
+            ))
+    fresh.stats["baselined"] = len(report.findings) - len(fresh.findings)
+    fresh.stats["stale_baseline_entries"] = len(remaining)
+    return fresh, remaining

@@ -39,6 +39,21 @@ _IGNORED_ROOT_KEYS = {
 }
 
 
+def did_you_mean(unknown, options) -> str:
+    """`` (did you mean: 'schedul' -> 'schedule'?)`` suffix for unknown-key
+    rejections — every validated knob block appends it so a typo'd knob
+    fails with its correction, not just a list to eyeball."""
+    import difflib
+
+    hints = []
+    for u in sorted(str(k) for k in unknown):
+        close = difflib.get_close_matches(u, [str(o) for o in options],
+                                          n=1, cutoff=0.6)
+        if close:
+            hints.append(f"{u!r} -> {close[0]!r}")
+    return f" (did you mean: {', '.join(hints)}?)" if hints else ""
+
+
 class ConfigDict(dict):
     """dict with attribute access and safe ``get`` chaining (``cfg.model.optim.lr``)."""
 
@@ -185,7 +200,14 @@ def validate_config(cfg: ConfigDict) -> None:
     # full model-aware gate is parallel.pipeline.supports_1f1b (resolved at
     # trainer build); the config-shape constraints die here with curated
     # messages.
-    pipe_knobs = dict(ds.get("pipeline", {}) or {})
+    pipe_raw = ds.get("pipeline", {}) or {}
+    if not isinstance(pipe_raw, Mapping):
+        raise ValueError(
+            f"distributed_strategy.pipeline must be a mapping of knobs "
+            f"(schedule: auto/1f1b/wavefront), got "
+            f"{type(pipe_raw).__name__}: {pipe_raw!r}"
+        )
+    pipe_knobs = dict(pipe_raw)
     if pipe_knobs:
         from neuronx_distributed_training_tpu.parallel.pipeline import (
             PIPELINE_SCHEDULES,
@@ -197,6 +219,7 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError(
                 f"unknown distributed_strategy.pipeline keys {sorted(unknown)}; "
                 f"supported: schedule ({'/'.join(PIPELINE_SCHEDULES)})"
+                + did_you_mean(unknown, {"schedule"})
             )
         sched_knob = str(pipe_knobs.get("schedule", "auto")).lower()
         if sched_knob not in PIPELINE_SCHEDULES:

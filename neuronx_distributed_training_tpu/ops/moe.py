@@ -250,12 +250,29 @@ def moe_dropless(params, x: jax.Array, cfg: MoEConfig, *, compute_dtype=jnp.bflo
     xs = x.astype(compute_dtype)[token_of]  # [T*k, h] gathered rows
     group_sizes = jnp.bincount(flat_expert, length=e)
 
-    gu = jax.lax.ragged_dot(xs, params["experts"]["gate_up"].astype(compute_dtype),
-                            group_sizes)
+    # XLA's SPMD partitioner has no rule for ragged_dot's GROUP dimension:
+    # with the expert dim sharded it computes each shard's local expert
+    # slice against the GLOBAL group offsets — silently wrong values, no
+    # error (full-signal corruption on any mesh where the expert axis is
+    # strided, e.g. EP x TP; verified empirically on jax 0.4.x).  Constrain
+    # the weights to be gathered over 'expert' for the compute — weight-
+    # gather EP: the resident weights and optimizer state stay sharded per
+    # expert_specs, GSPMD inserts one all-gather per layer, and the ffn
+    # dim's 'model' sharding (which ragged_dot partitions correctly) is
+    # preserved.  Sharded-vs-unsharded parity: tests/test_mixtral.py.
+    from neuronx_distributed_training_tpu.parallel import sharding as shd
+
+    gu_w = shd.constrain(
+        params["experts"]["gate_up"].astype(compute_dtype),
+        P(None, None, "model"))
+    down_w = shd.constrain(
+        params["experts"]["down"].astype(compute_dtype),
+        P(None, "model", None))
+
+    gu = jax.lax.ragged_dot(xs, gu_w, group_sizes)
     gate, up = jnp.split(gu, 2, axis=-1)
     act = jax.nn.silu(gate) * up
-    ys = jax.lax.ragged_dot(act, params["experts"]["down"].astype(compute_dtype),
-                            group_sizes)  # [T*k, h]
+    ys = jax.lax.ragged_dot(act, down_w, group_sizes)  # [T*k, h]
 
     w = probs.reshape(-1)[order].astype(compute_dtype)  # gate weight per row
     y = jnp.zeros((t, h), compute_dtype).at[token_of].add(ys * w[:, None])

@@ -137,6 +137,56 @@ def constrain(x, spec: Optional[P], mesh: Optional[Mesh] = None):
         return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
 
 
+def spec_errors(specs, mesh: Mesh) -> list[str]:
+    """Static PartitionSpec lint over a spec pytree: every named axis must
+    exist in ``mesh`` and no axis may be used twice within one spec (XLA
+    rejects the latter late, with a partitioner error that names neither the
+    leaf nor the axis).  Returns curated ``path: problem`` strings; empty
+    means clean.  The pre-flight graph auditor runs this before lowering so
+    a bad spec dies with a leaf path instead of a GSPMD traceback."""
+    known = set(mesh.axis_names)
+    errors: list[str] = []
+
+    def visit(path, spec):
+        if spec is None or not isinstance(spec, P):
+            return spec
+        where = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                         for p in path) or "<root>"
+        seen: set[str] = set()
+        for dim in spec:
+            for ax in (dim if isinstance(dim, tuple) else (dim,)):
+                if ax is None:
+                    continue
+                if ax not in known:
+                    errors.append(
+                        f"{where}: spec {spec} names axis {ax!r} absent from "
+                        f"mesh axes {sorted(known)}"
+                    )
+                elif ax in seen:
+                    errors.append(
+                        f"{where}: spec {spec} uses axis {ax!r} twice — one "
+                        f"mesh axis cannot shard two tensor dims"
+                    )
+                seen.add(ax)
+        return spec
+
+    jax.tree_util.tree_map_with_path(
+        visit, specs, is_leaf=lambda x: isinstance(x, P) or x is None,
+    )
+    return errors
+
+
+def validate_specs(specs, mesh: Mesh) -> None:
+    """Raise ``ValueError`` listing every defect ``spec_errors`` finds."""
+    errors = spec_errors(specs, mesh)
+    if errors:
+        raise ValueError(
+            "invalid PartitionSpecs:\n  " + "\n  ".join(errors[:20])
+            + (f"\n  ... and {len(errors) - 20} more" if len(errors) > 20
+               else "")
+        )
+
+
 def seq_axes(sequence_parallel: bool, context_parallel: bool):
     """Mesh axes the activation sequence dim is sharded over between blocks.
 

@@ -26,6 +26,14 @@ _MEMORY_FIELDS = (
 )
 
 
+def hlo_texts_from_compiled(compiled: Any) -> list[str]:
+    """Post-SPMD HLO module texts of a ``.compile()``d executable — the one
+    artifact both the collective census (``utils.debug``) and the static
+    graph auditor (``analysis.graph_audit``) parse.  Kept here so "what the
+    compiler actually produced" has a single accessor."""
+    return [m.to_string() for m in compiled.runtime_executable().hlo_modules()]
+
+
 def memory_analysis_bytes(compiled: Any) -> Optional[dict[str, int]]:
     """``compiled.memory_analysis()`` -> plain dict (None when the backend
     doesn't implement it).  ``peak_bytes`` is the classic static estimate

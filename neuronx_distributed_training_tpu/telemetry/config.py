@@ -41,6 +41,12 @@ TELEMETRY_KNOBS: dict[str, bool] = {
     "compile_census": True,
     "device_memory": False,
     "goodput": True,
+    # static graph audit of the census executable (analysis.graph_audit):
+    # donation/collective/replication/precision contract checks on the very
+    # step about to run, logged + persisted to run_summary.json.  Host-side
+    # HLO text parsing at first compile only; off by default because large
+    # programs make the text walk a noticeable one-time cost.
+    "graph_audit": False,
 }
 
 
@@ -51,6 +57,7 @@ class TelemetryConfig:
     compile_census: bool = True
     device_memory: bool = False
     goodput: bool = True
+    graph_audit: bool = False
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
 
     @classmethod
@@ -77,9 +84,14 @@ class TelemetryConfig:
             )
         unknown = set(block) - set(TELEMETRY_KNOBS) - {"health"}
         if unknown:
+            from neuronx_distributed_training_tpu.config.loader import (
+                did_you_mean,
+            )
+
+            options = sorted(TELEMETRY_KNOBS) + ["health"]
             raise ValueError(
                 f"unknown exp_manager.telemetry keys {sorted(unknown)}; "
-                f"supported: {sorted(TELEMETRY_KNOBS) + ['health']}"
+                f"supported: {options}" + did_you_mean(unknown, options)
             )
         values: dict[str, Any] = {}
         for k, v in block.items():

@@ -88,9 +88,13 @@ class HealthConfig:
             )
         unknown = set(block) - knobs
         if unknown:
+            from neuronx_distributed_training_tpu.config.loader import (
+                did_you_mean,
+            )
+
             raise ValueError(
                 f"unknown exp_manager.telemetry.health keys {sorted(unknown)}; "
-                f"supported: {sorted(knobs)}"
+                f"supported: {sorted(knobs)}" + did_you_mean(unknown, knobs)
             )
         values = dict(block)
         policy = str(values.get("policy", cls.policy))

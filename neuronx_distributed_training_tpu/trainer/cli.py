@@ -80,6 +80,11 @@ def main() -> None:
                     metavar="KEY=VAL", help="dotted config override")
     ap.add_argument("--compile-only", action="store_true",
                     help="AOT-compile the train step and exit (COMPILE=1 analogue)")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="pre-flight static audit (analysis.graph_audit) of "
+                         "THIS config at true size on this machine's "
+                         "devices, then exit non-zero on error findings — "
+                         "no params materialized, no data opened")
     ap.add_argument("--compilation-cache", default=os.environ.get(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/nxdt_xla_cache"),
         help="persistent XLA compilation cache dir")
@@ -109,6 +114,16 @@ def main() -> None:
     overrides = parse_overrides(args.overrides)
     if os.environ.get("TRAIN_ITERS"):  # reference test hook
         overrides["trainer.max_steps"] = int(os.environ["TRAIN_ITERS"])
+
+    if args.audit_only:
+        from neuronx_distributed_training_tpu.analysis.graph_audit import (
+            audit_config,
+        )
+
+        report = audit_config(args.config, shrink=False, overrides=overrides)
+        print(report.format())
+        raise SystemExit(1 if report.failed("error") else 0)
+
     cfg = load_config(args.config, overrides)
 
     trainer = Trainer.from_config(cfg, enable_checkpointing=not args.compile_only)

@@ -152,6 +152,60 @@ def _sidecar_store(path, done, cols):
 
 
 @dataclasses.dataclass
+class StepProgram:
+    """The config's train step as a PROGRAM, before any device state exists.
+
+    Everything ``Trainer.from_config`` derives purely from the config — mesh,
+    dtype policy, model, loss, specs, the jitted (but un-lowered) train step,
+    abstract param/opt trees — with zero arrays materialized and no data files
+    opened.  Two consumers:
+
+    - ``Trainer.from_config`` materializes it (sharded-at-birth init, data
+      modules, checkpointing) into a live session;
+    - ``analysis.graph_audit`` AOT-lowers it on abstract inputs and checks the
+      compiled artifact against the config's declared contracts (donation,
+      collective census, precision) without spending a device-hour.
+
+    ``build_data=False`` (the audit path) skips ``build_data_module`` entirely:
+    no tokenizer download, no arrow/mmap open — ``shift_labels`` is derived
+    statically (Megatron mmap data, the only pre-shifted source, is keyed on
+    ``data.data_prefix``) and both data modules stay ``None``.
+    """
+
+    cfg: ConfigDict
+    mesh: Any
+    mesh_cfg: Any
+    policy: DtypePolicy
+    sched: dict
+    seed: int
+    alignment: str
+    align_params: dict
+    model_cfg: Any
+    loss_fn: Callable
+    eval_loss_fn: Callable
+    forward_logits: Optional[Callable]
+    param_builder: Callable
+    init_key: Any
+    abstract_params: Any
+    pspecs: Any
+    ospecs: Any
+    opt_cfg: Any
+    ema_cfg: Optional[Any]
+    health_cfg: Any
+    trainable: Any
+    lora_block: dict
+    jstep: Callable
+    eval_fn: Optional[Callable]
+    data_module: Optional[DataModule]
+    val_data_module: Optional[DataModule]
+    shift_labels: bool
+    pipeline_schedule: Optional[str]
+    num_micro_in_step: int
+    max_steps: int
+    donate: Any
+
+
+@dataclasses.dataclass
 class Trainer:
     """Assembled training session.  Build with ``Trainer.from_config``."""
 
@@ -178,6 +232,9 @@ class Trainer:
     # static facts of the run (model family, chips, seq len, analytic FLOPs)
     # persisted with the compile census into run_summary.json
     run_facts: dict = dataclasses.field(default_factory=dict)
+    # donation mode the jitted step was built with (StepProgram.donate) —
+    # the in-loop graph audit checks the SAME donated set, not a re-derived one
+    donate: Any = True
 
     # -- assembly -----------------------------------------------------------
 
@@ -191,6 +248,32 @@ class Trainer:
         devices: Optional[list] = None,
         enable_checkpointing: bool = True,
     ) -> "Trainer":
+        devices = devices if devices is not None else jax.devices()
+        asm = cls.assemble(
+            cfg, devices=devices, data_module=data_module,
+            val_data_module=val_data_module,
+        )
+        return cls._materialize(
+            asm, devices=devices, enable_checkpointing=enable_checkpointing
+        )
+
+    @staticmethod
+    def assemble(
+        cfg: ConfigDict,
+        *,
+        devices: Optional[list] = None,
+        data_module: Optional[DataModule] = None,
+        val_data_module: Optional[DataModule] = None,
+        build_data: bool = True,
+    ) -> StepProgram:
+        """Derive the config's :class:`StepProgram` — everything up to (and
+        including) the jitted train step — with zero arrays materialized.
+
+        ``build_data=False`` (the graph-audit path) additionally skips the
+        data-module build: no tokenizer fetch, no arrow/mmap open.
+        ``shift_labels`` is then derived statically — the Megatron mmap
+        module (keyed on ``data.data_prefix``, pretraining only) is the one
+        pre-shifted source the dispatch can produce."""
         devices = devices if devices is not None else jax.devices()
         mesh_cfg = MeshConfig.from_config(cfg.get("distributed_strategy", {}))
         mesh = build_mesh(mesh_cfg, devices=devices)
@@ -206,13 +289,19 @@ class Trainer:
         )
 
         alignment, align_params = alignment_strategy(cfg)
-        if data_module is None:
-            data_module, cfg_val_dm = build_data_module(cfg, sched, seed=seed)
-            if val_data_module is None:
-                val_data_module = cfg_val_dm
-        # Megatron mmap data is pre-shifted on host (gpt_dataset_patch
-        # convention); everything else relies on the in-model shift
-        shift_labels = not getattr(data_module, "labels_pre_shifted", False)
+        if build_data:
+            if data_module is None:
+                data_module, cfg_val_dm = build_data_module(cfg, sched, seed=seed)
+                if val_data_module is None:
+                    val_data_module = cfg_val_dm
+            # Megatron mmap data is pre-shifted on host (gpt_dataset_patch
+            # convention); everything else relies on the in-model shift
+            shift_labels = not getattr(data_module, "labels_pre_shifted", False)
+        else:
+            shift_labels = not (
+                not alignment
+                and (cfg.get("data", {}) or {}).get("data_prefix")
+            )
 
         model_cfg, loss_fn, init_fn, specs_fn = build_model(
             cfg, policy, shift_labels=shift_labels
@@ -229,6 +318,7 @@ class Trainer:
         # DPO/ORPO swap the loss for the preference objective; DPO's pre-fit
         # reference-logprob pass runs in fit() (reference base_dpo.py:23-66),
         # ORPO needs no reference model (reference base_orpo.py:26-46)
+        forward_logits = None
         if alignment in ("dpo", "orpo", "kto"):
             dpo_cfg = dict((cfg.get("model", {}) or {}).get(alignment, {}) or {})
             forward_logits = _forward_logits_for(model_cfg, policy)
@@ -591,9 +681,43 @@ class Trainer:
         # failing opt-state donation — the transient cost drops from
         # params+opt to opt-state-only.  Revisit donate="all" under EMA when
         # the backend can be exercised (tools/ema_donation_probe.py).
-        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs,
-                               donate=True if ema_cfg is None else "params")
+        donate = True if ema_cfg is None else "params"
+        jstep = jit_train_step(step_fn, mesh, pspecs, ospecs, donate=donate)
         eval_fn = jax.jit(make_eval_step(eval_loss_fn)) if val_data_module else None
+
+        return StepProgram(
+            cfg=cfg, mesh=mesh, mesh_cfg=mesh_cfg, policy=policy, sched=sched,
+            seed=seed, alignment=alignment, align_params=align_params,
+            model_cfg=model_cfg, loss_fn=loss_fn, eval_loss_fn=eval_loss_fn,
+            forward_logits=forward_logits, param_builder=param_builder,
+            init_key=init_key, abstract_params=abstract_params,
+            pspecs=pspecs, ospecs=ospecs, opt_cfg=opt_cfg, ema_cfg=ema_cfg,
+            health_cfg=health_cfg, trainable=trainable, lora_block=lora_block,
+            jstep=jstep, eval_fn=eval_fn, data_module=data_module,
+            val_data_module=val_data_module, shift_labels=shift_labels,
+            pipeline_schedule=pp_schedule, num_micro_in_step=num_micro_in_step,
+            max_steps=max_steps, donate=donate,
+        )
+
+    @classmethod
+    def _materialize(
+        cls, asm: StepProgram, *, devices: list, enable_checkpointing: bool
+    ) -> "Trainer":
+        """Turn a :class:`StepProgram` into a live session: sharded-at-birth
+        param/opt-state init, warm start, sharding validation, exp manager,
+        checkpointing, and the DPO/KTO reference-logprob pre-fit hook."""
+        cfg, mesh, mesh_cfg = asm.cfg, asm.mesh, asm.mesh_cfg
+        policy, sched, seed = asm.policy, asm.sched, asm.seed
+        model_cfg, loss_fn = asm.model_cfg, asm.loss_fn
+        pspecs, ospecs = asm.pspecs, asm.ospecs
+        param_builder, init_key = asm.param_builder, asm.init_key
+        ema_cfg, health_cfg = asm.ema_cfg, asm.health_cfg
+        alignment, forward_logits = asm.alignment, asm.forward_logits
+        data_module = asm.data_module
+        val_data_module = asm.val_data_module
+        jstep, eval_fn = asm.jstep, asm.eval_fn
+        pp_schedule, max_steps = asm.pipeline_schedule, asm.max_steps
+        pp = int(mesh.shape.get("pipe", 1))
 
         # materialize sharded-at-birth: jit with out_shardings creates every
         # leaf directly on its own devices — no full-model host/single-device
@@ -873,6 +997,7 @@ class Trainer:
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
             max_steps=max_steps, pre_fit=pre_fit, ema_cfg=ema_cfg,
             pipeline_schedule=pp_schedule, run_facts=run_facts,
+            donate=asm.donate,
         )
 
     # -- resume -------------------------------------------------------------
@@ -1211,9 +1336,10 @@ class Trainer:
         # minutes on TPU, and a sync-tuned timeout would false-abort it
         try:
             t0 = _time.perf_counter()
-            compiled = self.train_step.lower(
+            lowered = self.train_step.lower(
                 self.params, self.opt_state, batch, key
-            ).compile()
+            )
+            compiled = lowered.compile()
             dt = _time.perf_counter() - t0
         except Exception as e:  # noqa: BLE001 — census is best-effort
             logger.warning(
@@ -1245,6 +1371,38 @@ class Trainer:
                 "compile census harvest/write failed (the compiled step is "
                 "still in use): %s", e
             )
+        if self.exp.telemetry.graph_audit:
+            self._graph_audit(compiled, lowered)
+
+    def _graph_audit(self, compiled, lowered) -> None:
+        """telemetry.graph_audit: run the static contract rules
+        (analysis.graph_audit) against the very executable the loop is about
+        to train with, log every finding, and persist the verdict to
+        run_summary.json.  Pure host-side HLO inspection — no device work,
+        no extra compiles; failures degrade to a warning (the audit gates
+        pre-flight in tools/preflight_audit.py; in-loop it only observes)."""
+        try:
+            from neuronx_distributed_training_tpu.analysis.graph_audit import (
+                AuditContext,
+                audit_executable,
+            )
+            from neuronx_distributed_training_tpu.config.loader import (
+                batch_schedule,
+            )
+
+            ctx = AuditContext(
+                cfg=self.cfg, mesh=self.mesh, policy=self.policy,
+                model_cfg=self.model_cfg,
+                sched=batch_schedule(self.cfg, int(self.mesh.devices.size)),
+                donate=self.donate,
+                params_tree=self.params, opt_tree=self.opt_state,
+                pspecs=self.param_specs, ospecs=self.opt_specs,
+            )
+            rep = audit_executable(ctx, compiled, lowered,
+                                   log=logger.warning)
+            self.exp.write_run_summary({"graph_audit": rep.to_dict()})
+        except Exception as e:  # noqa: BLE001 — observability must not kill
+            logger.warning("graph audit failed: %s", e)
 
     def validate(self, limit_batches: int, detector=None) -> float:
         params = self.params
@@ -1465,6 +1623,12 @@ def pipeline_hooks_for(cfg: ConfigDict, model_cfg: Any, policy: DtypePolicy,
     raise NotImplementedError(
         f"pipeline parallelism not wired for {type(model_cfg).__name__} yet"
     )
+
+
+def assemble_step_program(cfg: ConfigDict, **kw: Any) -> StepProgram:
+    """Module-level alias of :meth:`Trainer.assemble` — the entry point the
+    static graph auditor (``analysis.graph_audit``) builds on."""
+    return Trainer.assemble(cfg, **kw)
 
 
 def train(cfg: ConfigDict, **kw: Any) -> dict[str, float]:

@@ -101,7 +101,17 @@ def collective_counts_from_compiled(compiled) -> dict[str, int]:
     """Collective census of an ALREADY-compiled executable (`.compile()`
     result) — the zero-extra-compile path the telemetry census uses on the
     train step it is about to run."""
-    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()]
+    from neuronx_distributed_training_tpu.telemetry.census import (
+        hlo_texts_from_compiled,
+    )
+
+    return collective_counts_from_texts(hlo_texts_from_compiled(compiled))
+
+
+def collective_counts_from_texts(texts: list[str]) -> dict[str, int]:
+    """Census over HLO texts already in hand — callers that walk the text
+    for other rules too (the graph auditor) avoid a second multi-MB
+    ``to_string`` per module."""
     counts = {k: 0 for k in _COLLECTIVES}
     # HLO line shapes: `%name = f32[4,8]{1,0} all-reduce(%dot), ...` and the
     # combined/async forms `%ar = (f32[..], f32[..]) all-reduce-start(...)`;

@@ -354,3 +354,61 @@ class TestPipelineScheduleKnob:
             load_config(self._base(
                 **{"distributed_strategy.pipeline.schedule": sched,
                    "distributed_strategy.pipeline_model_parallel_size": 2}))
+
+
+class TestUnknownKnobRejection:
+    """Every validated knob block rejects unknown keys with a did-you-mean
+    hint — a typo'd knob must die at load, corrected, not silently run with
+    defaults."""
+
+    _base = TestValidationCatalog._base
+    _expect = TestValidationCatalog._expect
+
+    def test_pipeline_typo_hint(self):
+        self._expect(r"did you mean: 'schedul' -> 'schedule'",
+                     **{"distributed_strategy.pipeline.schedul": "1f1b",
+                        "distributed_strategy.pipeline_model_parallel_size": 2})
+
+    def test_pipeline_non_mapping_block(self):
+        self._expect("distributed_strategy.pipeline must be a mapping",
+                     **{"distributed_strategy.pipeline": "1f1b"})
+
+    def test_pipeline_unknown_without_close_match(self):
+        # far-off keys still rejected, just without a suggestion
+        self._expect("unknown distributed_strategy.pipeline keys",
+                     **{"distributed_strategy.pipeline.zzz": 1,
+                        "distributed_strategy.pipeline_model_parallel_size": 2})
+
+    def test_telemetry_typo_hint(self):
+        self._expect(r"did you mean: 'spanss' -> 'spans'",
+                     **{"exp_manager.telemetry.spanss": True})
+
+    def test_telemetry_non_mapping_block(self):
+        self._expect("exp_manager.telemetry must be a mapping",
+                     **{"exp_manager.telemetry": [1, 2]})
+
+    def test_telemetry_non_bool_knob(self):
+        self._expect("must be a boolean",
+                     **{"exp_manager.telemetry.mfu": "yes"})
+
+    def test_health_typo_hint(self):
+        self._expect(r"did you mean: 'polcy' -> 'policy'",
+                     **{"exp_manager.telemetry.health.polcy": "halt"})
+
+    def test_health_unknown_policy_value(self):
+        self._expect("policy must be one of",
+                     **{"exp_manager.telemetry.health.policy": "explode"})
+
+    def test_health_non_mapping_block(self):
+        self._expect("telemetry.health must be a mapping",
+                     **{"exp_manager.telemetry.health": [1]})
+
+    def test_graph_audit_knob_accepted(self):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+
+        cfg = load_config(self._base(
+            **{"exp_manager.telemetry.graph_audit": True}))
+        from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+
+        tc = TelemetryConfig.from_config(cfg.exp_manager.telemetry)
+        assert tc.graph_audit is True

@@ -1,0 +1,286 @@
+"""jaxlint: every rule fires on a seeded violation, suppressions and the
+ratchet baseline behave, and the package itself lints clean against the
+committed baseline (the acceptance criterion)."""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from neuronx_distributed_training_tpu.analysis import jaxlint
+from neuronx_distributed_training_tpu.analysis.jaxlint import (
+    apply_ratchet,
+    fingerprint,
+    lint_file,
+    lint_package,
+    load_baseline,
+    module_is_graph,
+    write_baseline,
+)
+
+
+def lint_snippet(tmp_path: Path, code: str, name: str = "snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code))
+    return lint_file(f, tmp_path)
+
+
+GRAPH_HEADER = """
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+"""
+
+
+class TestRulesFire:
+    def test_jl101_item_and_float(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch):
+    v = float(jnp.sum(batch))
+    s = batch.sum().item()
+    return v + s
+
+g = jax.grad(loss_fn)
+""")
+        assert sum(f.rule == "JL101" for f in rep.findings) == 2, rep.format()
+
+    def test_jl101_asarray_on_param(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch):
+    host = np.asarray(batch)
+    return host.sum()
+
+g = jax.jit(loss_fn)
+""")
+        assert any(f.rule == "JL101" and "asarray" in f.message
+                   for f in rep.findings), rep.format()
+
+    def test_jl102_tracer_branch(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    if jnp.any(x > 0):
+        x = x + 1
+    while jnp.max(x) < 3:
+        x = x * 2
+    return x
+
+g = jax.grad(loss_fn)
+""")
+        assert sum(f.rule == "JL102" for f in rep.findings) == 2, rep.format()
+
+    def test_jl102_static_metadata_ok(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    if jnp.dtype(x.dtype) != jnp.dtype(jnp.float32):
+        x = x.astype(jnp.float32)
+    if jnp.ndim(x) == 2:
+        x = x[None]
+    return x
+
+g = jax.grad(loss_fn)
+""")
+        assert not [f for f in rep.findings if f.rule == "JL102"], rep.format()
+
+    def test_jl103_wall_clock(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def step_fn(params, x):
+    t0 = time.time()
+    t1 = time.perf_counter()
+    return x * (t1 - t0)
+
+g = jax.jit(step_fn)
+""")
+        assert sum(f.rule == "JL103" for f in rep.findings) == 2, rep.format()
+
+    def test_jl104_key_reuse(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def sample(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a + b
+""")
+        assert any(f.rule == "JL104" for f in rep.findings), rep.format()
+
+    def test_jl104_split_and_rebind_ok(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    key = jax.random.fold_in(key, 1)
+    c = jax.random.normal(key, (2,))
+    d = jax.random.normal(jax.random.fold_in(key, 2), (2,))
+    return a + b + c + d
+""")
+        assert not [f for f in rep.findings if f.rule == "JL104"], rep.format()
+
+    def test_jl104_exclusive_branches_not_reuse(self, tmp_path):
+        """One consumer per if/else branch: mutually exclusive, not reuse —
+        but a use AFTER the branches (either path already consumed) is."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def sample(key, training):
+    if training:
+        x = jax.random.bernoulli(key, 0.5)
+    else:
+        x = jax.random.uniform(key)
+    return x
+""")
+        assert not [f for f in rep.findings if f.rule == "JL104"], rep.format()
+        rep2 = lint_snippet(tmp_path, GRAPH_HEADER + """
+def sample(key, training):
+    if training:
+        x = jax.random.bernoulli(key, 0.5)
+    else:
+        x = jax.random.uniform(key)
+    return x + jax.random.normal(key, ())
+""", name="snippet2.py")
+        assert sum(f.rule == "JL104" for f in rep2.findings) == 1, \
+            rep2.format()
+
+    def test_jl104_sibling_closures_independent(self, tmp_path):
+        """Two nested functions each using `key` once: not reuse."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def build(key):
+    def a():
+        return jax.random.normal(key, (2,))
+    def b():
+        return jax.random.uniform(key, (2,))
+    return a, b
+""")
+        assert not [f for f in rep.findings if f.rule == "JL104"], rep.format()
+
+    def test_jl105_donated_reuse(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def host_loop(params, opt, batch):
+    step = jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+    p2, o2 = step(params, opt, batch)
+    print(params)
+    return p2
+""")
+        assert any(f.rule == "JL105" and "`params`" in f.message
+                   for f in rep.findings), rep.format()
+
+    def test_jl105_rebind_ok(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def host_loop(params, opt, batch):
+    step = jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+    params, opt = step(params, opt, batch)
+    print(params)
+    return params
+""")
+        assert not [f for f in rep.findings if f.rule == "JL105"], rep.format()
+
+
+class TestScope:
+    def test_host_module_skips_graph_rules(self, tmp_path):
+        """Un-wrapped functions in a host-scope module: JL101-103 silent."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def boundary_fetch(metrics):
+    return float(jnp.asarray(0.0)) if metrics else 0.0
+""")
+        assert not [f for f in rep.findings if f.rule == "JL101"], rep.format()
+
+    def test_graph_pragma_forces_scope(self, tmp_path):
+        rep = lint_snippet(tmp_path, "# jaxlint: graph\n" + GRAPH_HEADER + """
+def helper(x):
+    return x.sum().item()
+""")
+        assert any(f.rule == "JL101" for f in rep.findings), rep.format()
+
+    def test_module_path_scope(self):
+        assert module_is_graph("models/llama.py", "")
+        assert module_is_graph("trainer/step.py", "")
+        assert not module_is_graph("trainer/loop.py", "")
+        assert not module_is_graph("data/loader.py", "")
+        assert module_is_graph("data/loader.py", "# jaxlint: graph\n")
+
+
+class TestSuppression:
+    def test_line_suppression(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    a = x.sum().item()  # jaxlint: disable=JL101
+    b = x.sum().item()
+    return a + b
+
+g = jax.grad(loss_fn)
+""")
+        assert sum(f.rule == "JL101" for f in rep.findings) == 1, rep.format()
+
+    def test_previous_line_suppression(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    # jaxlint: disable=JL101
+    a = x.sum().item()
+    return a
+
+g = jax.grad(loss_fn)
+""")
+        assert not [f for f in rep.findings if f.rule == "JL101"], rep.format()
+
+
+class TestRatchet:
+    def _one_finding_report(self, tmp_path):
+        return lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    return x.sum().item()
+
+g = jax.grad(loss_fn)
+""")
+
+    def test_baselined_finding_passes(self, tmp_path):
+        rep = self._one_finding_report(tmp_path)
+        baseline = [fingerprint(f) for f in rep.findings]
+        fresh, stale = apply_ratchet(rep, baseline)
+        assert not fresh.findings and not stale
+        assert fresh.stats["baselined"] == 1
+
+    def test_new_finding_escalates_to_error(self, tmp_path):
+        rep = self._one_finding_report(tmp_path)
+        fresh, stale = apply_ratchet(rep, [])
+        assert fresh.findings and fresh.findings[0].severity == "error"
+        assert fresh.failed("error")
+
+    def test_stale_baseline_entry_reported(self, tmp_path):
+        rep = self._one_finding_report(tmp_path)
+        baseline = [fingerprint(f) for f in rep.findings] + [
+            "JL101|gone.py|removed_long_ago()"]
+        fresh, stale = apply_ratchet(rep, baseline)
+        assert stale == ["JL101|gone.py|removed_long_ago()"]
+
+    def test_fingerprint_stable_across_line_moves(self, tmp_path):
+        rep1 = self._one_finding_report(tmp_path)
+        rep2 = lint_snippet(tmp_path, "\n\n\n" + GRAPH_HEADER + """
+def loss_fn(params, x):
+    return x.sum().item()
+
+g = jax.grad(loss_fn)
+""", name="snippet2.py")
+        fp1 = fingerprint(rep1.findings[0]).split("|", 1)[1].split("|", 1)[1]
+        fp2 = fingerprint(rep2.findings[0]).split("|", 1)[1].split("|", 1)[1]
+        assert fp1 == fp2  # same snippet despite the line shift
+
+    def test_write_and_load_roundtrip(self, tmp_path):
+        rep = self._one_finding_report(tmp_path)
+        path = tmp_path / "baseline.json"
+        write_baseline(rep, path)
+        assert load_baseline(path) == sorted(
+            fingerprint(f) for f in rep.findings)
+        assert json.loads(path.read_text())["findings"]
+
+
+def test_package_lints_clean_against_committed_baseline():
+    """The acceptance criterion: zero non-baselined findings on the package
+    source, and zero stale entries in the committed baseline."""
+    full = lint_package()
+    fresh, stale = apply_ratchet(full, load_baseline())
+    assert not fresh.findings, fresh.format()
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+def test_unparseable_file_is_error(tmp_path):
+    rep = lint_snippet(tmp_path, "def broken(:\n")
+    assert any(f.rule == "JL000" and f.severity == "error"
+               for f in rep.findings)

@@ -85,7 +85,18 @@ class TestMixtralForward:
 
 class TestMixtralSharded:
     def test_ep_tp_parity(self, devices8):
-        """EP=2 x TP=2 x DP=2 sharded loss/grads match unsharded."""
+        """EP=2 x TP=2 x DP=2 sharded loss/grads match unsharded.
+
+        Regression pin for the ragged_dot EP hazard: XLA's SPMD partitioner
+        has no rule for ragged_dot's GROUP dimension — with the expert dim
+        sharded on a strided mesh axis (any EP x TP mesh) it computed each
+        shard's local expert slice against the GLOBAL group offsets,
+        silently corrupting forward AND backward (loss off ~7e-5, grads off
+        ~100% of signal, no error raised).  ``moe_dropless`` now gathers the
+        expert weights over 'expert' for the compute (weight-gather EP;
+        resident weights/opt state stay sharded), which restores bit-level
+        SPMD parity — so the tolerances here are tight: a reappearance of
+        the partitioner hole fails loudly."""
         params = mixtral.init_params(jax.random.PRNGKey(0), CFG, FP32)
         batch = _batch(jax.random.PRNGKey(1))
 

@@ -125,6 +125,48 @@ class TestEP:
             )(sh_params, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
 
+    def test_ep_tp_sharded_dropless_matches(self, devices8):
+        """Regression: dropless on an EP x TP mesh (STRIDED expert axis).
+
+        XLA's SPMD partitioner has no rule for ragged_dot's group dim; with
+        the expert dim sharded it silently computed local expert slices
+        against global group offsets — full-signal corruption (forward off
+        by the magnitude of y) with no error.  moe_dropless now gathers the
+        expert weights over 'expert' for the compute; parity must be tight
+        and the gradient path exact too."""
+        cfg = moe.MoEConfig(num_experts=4, top_k=2, dropless=True)
+        params, x = params_and_x(jax.random.PRNGKey(9), cfg=cfg)
+
+        def fwd(p, xx):
+            return moe.moe_dropless(p, xx, cfg, compute_dtype=jnp.float32)[0]
+
+        ref = fwd(params, x)
+        gref = jax.grad(lambda p, xx: (fwd(p, xx) ** 2).sum())(params, x)
+
+        mesh = build_mesh(
+            MeshConfig(tensor_model_parallel_size=2,
+                       expert_model_parallel_size=2),
+            devices=devices8[:4],
+        )
+        specs = moe.moe_param_specs(cfg)
+        sh_params = jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+        with mesh:
+            y = jax.jit(fwd)(sh_params, x)
+            g = jax.jit(jax.grad(lambda p, xx: (fwd(p, xx) ** 2).sum()))(
+                sh_params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(gref),
+                        jax.tree_util.tree_leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
 
 class TestTokenShuffle:
     """token_shuffle_group_size (reference transformer.py:410-411): de-bias

@@ -1,0 +1,114 @@
+"""PartitionSpec resolution edge cases: the static spec lint
+(``sharding.spec_errors``/``validate_specs``) plus the ``act_spec``/
+``heads_spec``/``logits_spec`` composition rules under SP/CP combinations —
+the spec-level contracts the graph auditor (GA401) builds on."""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from neuronx_distributed_training_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+)
+from neuronx_distributed_training_tpu.parallel.sharding import (
+    act_spec,
+    heads_spec,
+    logits_spec,
+    seq_axes,
+    spec_errors,
+    validate_specs,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh(devices8):
+    # pipe=1 data=2 expert=1 context=2 model=2
+    return build_mesh(
+        MeshConfig(tensor_model_parallel_size=2, context_parallel_size=2),
+        devices=devices8,
+    )
+
+
+class TestSpecErrors:
+    def test_clean_specs(self, mesh):
+        specs = {
+            "w": P(None, "model"),
+            "embed": P("model", None),
+            "act": P(("data", "expert"), "context", None),
+            "free": None,
+            "replicated": P(),
+        }
+        assert spec_errors(specs, mesh) == []
+
+    def test_absent_axis(self, mesh):
+        errs = spec_errors({"w": P("tensor")}, mesh)
+        assert len(errs) == 1
+        assert "tensor" in errs[0] and "absent" in errs[0]
+        assert "w" in errs[0]  # the leaf path is named
+
+    def test_conflicting_axis_across_dims(self, mesh):
+        """One mesh axis naming two tensor dims of the same spec."""
+        errs = spec_errors({"w": P("model", "model")}, mesh)
+        assert len(errs) == 1 and "twice" in errs[0]
+
+    def test_conflict_inside_compound_axis(self, mesh):
+        """Duplicate via a compound dim: P(('data','expert'), 'data')."""
+        errs = spec_errors({"x": P(("data", "expert"), "data")}, mesh)
+        assert len(errs) == 1 and "'data'" in errs[0]
+
+    def test_multiple_defects_all_reported(self, mesh):
+        errs = spec_errors(
+            {"a": P("bogus"), "b": P("model", "model")}, mesh)
+        assert len(errs) == 2
+
+    def test_validate_specs_raises_curated(self, mesh):
+        with pytest.raises(ValueError, match="invalid PartitionSpecs"):
+            validate_specs({"w": P("bogus_axis")}, mesh)
+
+    def test_nested_tree_paths(self, mesh):
+        errs = spec_errors(
+            {"layers": {"attn": {"q": P("nope")}}}, mesh)
+        assert "layers/attn/q" in errs[0]
+
+
+class TestSeqAxisComposition:
+    """CP splits the sequence first (outer), Megatron-SP shards the
+    remainder over the TP group — and the composed specs must stay legal
+    (each axis used at most once)."""
+
+    def test_seq_axes_combinations(self):
+        assert seq_axes(False, False) is None
+        assert seq_axes(True, False) == "model"
+        assert seq_axes(False, True) == "context"
+        assert seq_axes(True, True) == ("context", "model")
+
+    def test_act_spec_sp_under_cp_is_legal(self, mesh):
+        """sequence-parallel spec under cp>1: the compound seq dim uses
+        context AND model — exactly once each."""
+        spec = act_spec(sequence_parallel=True, context_parallel=True)
+        assert spec == P(("data", "expert"), ("context", "model"), None)
+        assert spec_errors({"act": spec}, mesh) == []
+
+    def test_heads_spec_under_cp(self, mesh):
+        """attention-internal: heads take model, seq keeps ONLY context
+        (attention needs the full TP-group sequence) — using model on both
+        would be the double-use defect spec_errors exists to catch."""
+        spec = heads_spec(context_parallel=True)
+        assert spec == P(("data", "expert"), "context", "model", None)
+        assert spec_errors({"heads": spec}, mesh) == []
+
+    def test_logits_spec_vocab_over_model(self, mesh):
+        spec = logits_spec(context_parallel=True)
+        assert spec == P(("data", "expert"), "context", "model")
+        assert spec_errors({"logits": spec}, mesh) == []
+
+    def test_sp_act_spec_on_cp_free_mesh(self, devices8):
+        """The same SP+CP spec against a mesh WITHOUT a context axis must be
+        flagged, not silently ignored."""
+        flat = Mesh(np.asarray(devices8).reshape(4, 2), ("data", "model"))
+        spec = act_spec(sequence_parallel=True, context_parallel=True)
+        errs = spec_errors({"act": spec}, flat)
+        assert len(errs) >= 1 and "context" in errs[0]
+        # 'expert' from the compound batch axis is missing on this mesh too
+        assert any("expert" in e for e in errs)

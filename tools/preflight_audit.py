@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Pre-flight static audit CLI — the gate that runs before a device-hour.
+
+Two layers (docs/static_analysis.md has the rule catalogue):
+
+- **graph audit** (``--config``): AOT-lowers the train step for a YAML config
+  on abstract inputs — no TPU, no data files, no arrays — and checks the
+  compiled artifact against the config's declared contracts (donation
+  aliased, collective census vs parallelism, replication budget, precision).
+- **source lint** (``--lint``): the jaxlint AST pass over the package with
+  its committed ratchet baseline; NEW findings (and stale baseline entries)
+  fail.
+
+Usage:
+
+    python tools/preflight_audit.py --config examples/conf/hf_llama3_8B_config.yaml
+    python tools/preflight_audit.py --lint
+    python tools/preflight_audit.py --all-examples --lint --json audit.json
+    python tools/preflight_audit.py --lint --update-baseline   # rebaseline
+
+Exit code 1 when any finding reaches ``--fail-on`` severity (default
+``error``; lint ratchet failures always count).  ``--json`` writes the full
+machine-readable report; the terminal always gets the human form.
+
+The graph audit shrinks large configs by default (degrees clamp to 2, dims
+to the smallest shapes satisfying them — the *structure* under audit is
+preserved); ``--no-shrink`` audits at the config's true size, which needs a
+real (or forced-host) device world that large.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _required_world(config_paths: list[str], shrink: bool) -> int:
+    """Device count the audits need — computed from raw YAML before jax
+    initializes, so the CPU world can still be sized via XLA_FLAGS."""
+    import yaml
+
+    from neuronx_distributed_training_tpu.config import loader as _loader
+
+    world = 1
+    for p in config_paths:
+        try:
+            with open(p) as f:
+                raw = yaml.safe_load(f) or {}
+            raw = _loader._resolve_tree(raw, raw)
+            ds = dict(raw.get("distributed_strategy") or {})
+
+            def deg(key):
+                try:
+                    v = int(ds.get(key) or 1)
+                except (TypeError, ValueError):
+                    v = 1
+                return min(v, 2) if shrink else v
+
+            base = (deg("tensor_model_parallel_size")
+                    * deg("pipeline_model_parallel_size")
+                    * deg("context_parallel_size")
+                    * deg("expert_model_parallel_size"))
+            world = max(world, base * 2)
+        except Exception:  # noqa: BLE001 — sizing is best-effort; audit reports
+            continue
+    return world
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--config", action="append", default=[],
+                    help="YAML config to graph-audit (repeatable)")
+    ap.add_argument("--all-examples", action="store_true",
+                    help="graph-audit every examples/conf/*.yaml")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the jaxlint source pass with the ratchet "
+                         "baseline")
+    ap.add_argument("--fail-on", choices=["warn", "error"], default="error",
+                    help="severity that fails the run (default: error)")
+    ap.add_argument("--no-shrink", dest="shrink", action="store_false",
+                    help="audit configs at true size (needs a device world "
+                         "as large as the config's parallel degrees)")
+    ap.add_argument("--replication-slack", type=float, default=8.0,
+                    help="GA201 fires above slack x the analytic per-device "
+                         "budget (default 8)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the jaxlint ratchet baseline from the "
+                         "current findings (review the diff!)")
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"],
+                    help="jax platform for the abstract lowering (default "
+                         "cpu: the audit is static)")
+    args = ap.parse_args()
+
+    configs = list(args.config)
+    if args.all_examples:
+        import glob
+
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        configs += sorted(glob.glob(os.path.join(here, "examples/conf/*.yaml")))
+    if not configs and not args.lint:
+        ap.error("nothing to do: pass --config/--all-examples and/or --lint")
+    if args.update_baseline and not args.lint:
+        ap.error("--update-baseline only makes sense with --lint (the "
+                 "baseline is regenerated from the lint findings)")
+
+    # Size the virtual device world BEFORE jax initializes its backend.
+    if configs and args.platform == "cpu":
+        world = max(_required_world(configs, args.shrink), 8)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={world}"
+            ).strip()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from neuronx_distributed_training_tpu.analysis import jaxlint
+    from neuronx_distributed_training_tpu.analysis.graph_audit import (
+        audit_config,
+    )
+
+    failed = False
+    out: dict = {"reports": []}
+
+    for path in configs:
+        rep = audit_config(
+            path, shrink=args.shrink,
+            replication_slack=args.replication_slack,
+        )
+        print(rep.format())
+        print()
+        out["reports"].append(rep.to_dict())
+        failed |= rep.failed(args.fail_on)
+
+    if args.lint:
+        full = jaxlint.lint_package()
+        if args.update_baseline:
+            jaxlint.write_baseline(full)
+            print(f"jaxlint: baseline rewritten with {len(full.findings)} "
+                  f"finding(s) -> {jaxlint.BASELINE_PATH}")
+        fresh, stale = jaxlint.apply_ratchet(full, jaxlint.load_baseline())
+        n_base = fresh.stats.get("baselined", 0)
+        if not fresh.findings and not stale:
+            print(f"jaxlint: clean ({n_base} baselined, 0 new)")
+        else:
+            print(fresh.format())
+            for entry in stale:
+                print(f"[ERROR] JL999: stale baseline entry (the finding it "
+                      f"grandfathers no longer exists): {entry}")
+                print("        fix: remove it from jaxlint_baseline.json "
+                      "(or run --update-baseline) — the ratchet only "
+                      "shrinks")
+            if not args.update_baseline:
+                failed = True
+        out["jaxlint"] = {
+            "new": [f.to_dict() for f in fresh.findings],
+            "baselined": n_base,
+            "stale_baseline_entries": stale,
+        }
+
+    if args.json:
+        payload = json.dumps(out, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()
