@@ -359,8 +359,14 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
     )
 
 
-def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> dict:
-    """One timed regime run; returns {ms_per_step, tokens_per_sec, mfu}."""
+def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
+              num_microbatches: int = 1) -> dict:
+    """One timed regime run; returns {ms_per_step, tokens_per_sec, mfu}.
+
+    ``mbs`` is the TOTAL rows per step; ``num_microbatches > 1`` runs the
+    trainer's real grad-accumulation scan (one optimizer update per step),
+    which is what the autotune cost model prices — the plan-topk sweep
+    passes it so predicted and measured steps are the same unit."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -404,6 +410,7 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
             return llama.forward(p, batch, cfg, policy)
 
         step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy,
+                               num_microbatches=num_microbatches,
                                param_specs=pspecs, health_cfg=health)
         jstep = jit_train_step(step, mesh, pspecs, ospecs)
 
@@ -515,6 +522,84 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int) -> 
     }
 
 
+def plan_topk_measure(dev, base_cfg, policy, precision_block, seq: int,
+                      mbs: int, steps: int, warmup: int, topk: int) -> dict:
+    """Measure the autotune planner's top-N plans for the bench workload and
+    score predicted-vs-measured rank agreement (Kendall tau).
+
+    The single-chip lattice varies remat policy (and microbatch count when
+    gbs allows), so this is a true end-to-end test of the cost model's
+    compute/memory terms: every bench run that passes ``--plan-topk``
+    appends a fresh calibration point to the JSON record.  A plan that
+    fails to run (e.g. remat=none OOM) is recorded with ``measured_ms:
+    null`` and excluded from tau."""
+    import dataclasses
+
+    from neuronx_distributed_training_tpu.autotune import (
+        kendall_tau,
+        plan_config,
+    )
+
+    raw = {
+        "name": "bench", "model_source": "hf",
+        "trainer": {"max_steps": 1},
+        "distributed_strategy": {"tensor_model_parallel_size": 1,
+                                 "zero1": True},
+        "data": {"seq_length": seq, "global_batch_size": mbs,
+                 "micro_batch_size": mbs, "synthetic": True},
+        "model": {
+            "architecture": "llama",
+            "vocab_size": base_cfg.vocab_size,
+            "hidden_size": base_cfg.hidden_size,
+            "intermediate_size": base_cfg.intermediate_size,
+            "num_layers": base_cfg.num_layers,
+            "num_attention_heads": base_cfg.num_attention_heads,
+            "num_key_value_heads": base_cfg.num_kv_heads,
+            "max_position_embeddings": seq,
+            "tie_word_embeddings": base_cfg.tie_word_embeddings,
+            "activations_checkpoint_granularity":
+                base_cfg.activations_checkpoint_granularity,
+        },
+        "precision": precision_block,
+    }
+    report = plan_config(raw, chips=1, audit=False, top_k=topk)
+    rows = []
+    predicted, measured = [], []
+    for cand in report.candidates[:topk]:
+        plan = cand.plan
+        cfg_i = dataclasses.replace(
+            base_cfg,
+            activations_checkpoint_granularity=(
+                None if plan.remat == "none" else plan.remat),
+        )
+        row = {"plan": plan.describe(),
+               "predicted_ms": round(cand.estimate.step_seconds * 1e3, 2),
+               "predicted_hbm_gb": round(cand.estimate.hbm_bytes / 1024**3,
+                                         3),
+               "measured_ms": None}
+        try:
+            # measure the SAME unit the estimate prices: all nm microbatches
+            # through the trainer's grad-accumulation scan with ONE
+            # optimizer update (naive per-microbatch scaling would count nm
+            # updates and bias the tau against small-mbs plans)
+            r = run_bench(dev, cfg_i, policy, seq, mbs, steps, warmup,
+                          num_microbatches=plan.num_microbatches)
+            row["measured_ms"] = r["ms_per_step"]
+            predicted.append(cand.estimate.step_seconds * 1e3)
+            measured.append(r["ms_per_step"])
+        except Exception as e:  # noqa: BLE001 — one failed plan must not
+            # kill the sweep (and its failure is itself signal)
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+            log(f"bench: plan-topk candidate failed: {row['error']}")
+        rows.append(row)
+    tau = kendall_tau(predicted, measured)
+    return {
+        "plans": rows,
+        "kendall_tau": json_float(tau) if tau is not None else None,
+        "n_measured": len(measured),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
@@ -552,6 +637,12 @@ def main() -> None:
                          "first (costs an extra client teardown)")
     ap.add_argument("--connect-timeout", type=float, default=300.0,
                     help="--direct watchdog budget for jax.devices()")
+    ap.add_argument("--plan-topk", type=int, default=0, metavar="N",
+                    help="additionally MEASURE the autotune planner's top-N "
+                         "single-chip plans (remat/microbatch lattice) and "
+                         "record predicted-vs-measured rank agreement "
+                         "(Kendall tau) in the JSON line — every bench run "
+                         "scores the cost model")
     ap.add_argument("--calibration", action="store_true",
                     help="low-fidelity connect-reliability run: append to the "
                          "measured log but do NOT refresh last_measured.json "
@@ -588,11 +679,19 @@ def main() -> None:
     #  - mixed_precision: bf16 compute, fp32 master + opt state (+fp32 grad
     #    accum) -> ~18 resident bytes/param incl. transient fp32 grads
     #  - bf16SR: everything bf16 -> ~8 bytes/param incl. transient grads
+    # The raw blocks are the single source both the measured policy AND the
+    # plan-topk ModelFacts derive from (they must agree or the predicted-vs-
+    # measured comparison silently compares different precisions).
+    precision_blocks = {
+        "mixed_precision": "mixed_precision",
+        "bf16": {"type": "bf16SR", "optimizer_dtype": "bf16",
+                 "grad_accum_dtype": "bf16"},
+    }
+    regime_bytes_per_param = {"mixed_precision": 18.0, "bf16": 8.0}
     regimes = {
-        "mixed_precision": (DtypePolicy.from_precision_config("mixed_precision"), 18.0),
-        "bf16": (DtypePolicy.from_precision_config(
-            {"type": "bf16SR", "optimizer_dtype": "bf16", "grad_accum_dtype": "bf16"}
-        ), 8.0),
+        name: (DtypePolicy.from_precision_config(block),
+               regime_bytes_per_param[name])
+        for name, block in precision_blocks.items()
     }
     if args.regime == "mixed":
         wanted = ["mixed_precision"]
@@ -606,6 +705,7 @@ def main() -> None:
     tied = not args.untied
     results: dict[str, dict] = {}
     errors: dict[str, str] = {}
+    used_cfgs: dict[str, object] = {}
     for name in wanted:
         policy, bpp = regimes[name]
         est = args.layers or layer_budget(hbm, bpp, tied=tied)
@@ -638,6 +738,7 @@ def main() -> None:
                 results[name] = run_bench(
                     dev, cfg, policy, seq, args.mbs, steps, warmup)
                 results[name]["tied_embeddings"] = tied
+                used_cfgs[name] = cfg
                 errors.pop(name, None)  # a successful backoff clears the record
                 break
             except Exception as e:  # noqa: BLE001 — keep the other regime alive
@@ -699,6 +800,21 @@ def main() -> None:
         payload[f"mfu_{name}"] = round(100 * res["mfu"], 2)
         payload[f"layers_{name}"] = res["num_layers"]
         payload[f"graph_audit_{name}"] = res.get("graph_audit")
+    if args.plan_topk and headline in used_cfgs:
+        # measure the planner's top-N plans for the HEADLINE workload and
+        # score the cost model's ranking against reality
+        try:
+            payload["plan_topk"] = plan_topk_measure(
+                dev, used_cfgs[headline], regimes[headline][0],
+                precision_blocks[headline], seq, args.mbs, steps, warmup,
+                args.plan_topk,
+            )
+            log(f"bench: plan-topk kendall_tau="
+                f"{payload['plan_topk']['kendall_tau']}")
+        except Exception as e:  # noqa: BLE001 — the headline line must
+            # survive a planner failure
+            payload["plan_topk"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+            log(f"bench: plan-topk failed: {payload['plan_topk']['error']}")
     if errors:
         payload["regime_errors"] = errors
     if backend_err:

@@ -655,8 +655,22 @@ def audit_artifacts(
         hlo_texts_from_compiled,
     )
 
+    from neuronx_distributed_training_tpu.telemetry.census import (
+        memory_analysis_bytes,
+    )
+
     report = AuditReport(config=config_name
                          or str(ctx.cfg.get("name", "") or ""))
+    # XLA's own memory accounting rides every audit (the autotune planner
+    # reads it back as the measured counterpart of its analytic HBM model;
+    # arguments + temps is the resident figure — outputs alias donated args)
+    mem = memory_analysis_bytes(compiled)
+    if mem is not None:
+        report.stats["memory_analysis"] = mem
+        report.stats["memory_bytes"] = (
+            mem.get("argument_size_in_bytes", 0)
+            + mem.get("temp_size_in_bytes", 0)
+        )
     try:
         hlo_texts = hlo_texts_from_compiled(compiled)
     except Exception as e:  # noqa: BLE001 — no HLO, no graph rules

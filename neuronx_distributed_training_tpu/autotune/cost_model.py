@@ -1,0 +1,401 @@
+"""Analytic roofline + memory model: predicted step time & HBM per plan.
+
+Three independent terms per plan, each a closed-form function of the
+:class:`~.space.ModelFacts`, the :class:`~.space.Plan`, and the
+:class:`~.topology.ChipTopology` — no lowering anywhere:
+
+- **compute**: the per-component FLOPs breakdown
+  (``utils.perf.flops_breakdown_for_model`` — the same accounting MFU uses)
+  x the fwd+2xbwd convention x a remat recompute multiplier, over
+  ``chips x peak x efficiency``.
+- **comms**: per-collective byte volumes (tp/SP layer collectives, dp
+  gradient reduction + ZeRO-1 regather, pp stage hops, cp ring/all-to-all
+  passes, ep token exchange) priced on the topology's ring model
+  ``bytes x (N-1)/(N x bw) + hops x latency``.
+- **bubble**: ``(pp-1)/nm`` of the in-pipeline work — the classic pipeline
+  fill/drain fraction (1F1B and the wavefront share it; they differ in
+  MEMORY, which the HBM model accounts separately).
+
+The HBM estimate mirrors the runtime's actual residency: params in
+``param_dtype`` (sharded tp x pp, experts additionally ep), gradients in
+``grad_accum_dtype``, AdamW moments (+ master when params are low-precision)
+under ZeRO-1's dp sharding, the local batch shard, scan-stacked remat
+residuals per policy, logits, and the dropless-MoE gathered-expert transient.
+``tests/test_autotune.py::TestMemoryCalibration`` pins it within +-15% of
+compiled ``memory_analysis()`` bytes on tiny configs so the planner's OOM
+pruning cannot drift from XLA reality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from neuronx_distributed_training_tpu.autotune.space import ModelFacts, Plan
+from neuronx_distributed_training_tpu.autotune.topology import ChipTopology
+
+
+def _policy_for(facts: ModelFacts):
+    from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+    return DtypePolicy.from_precision_config(facts.precision)
+
+
+def _dtype_bytes(dt) -> int:
+    import jax.numpy as jnp
+
+    return int(jnp.dtype(dt).itemsize)
+
+
+# --------------------------------------------------------------------------
+# parameter accounting (counts, with their shard denominators)
+# --------------------------------------------------------------------------
+
+
+def param_components(facts: ModelFacts, plan: Plan) -> dict[str, float]:
+    """Per-device parameter COUNTS by component, already divided by the
+    shard factors the specs apply (tp over weight matrices, pp over the
+    layer stack, ep x tp over expert stacks; norms replicated)."""
+    h, d = facts.hidden, facts.head_dim
+    nh, nkv = facts.num_heads, facts.num_kv_heads
+    tp, pp, ep = plan.tp, plan.pp, plan.ep
+    L = facts.num_layers
+
+    embed = facts.vocab * h / tp
+    qkv = h * (nh + 2 * nkv) * d / tp
+    o = nh * d * h / tp
+    norms = 2.0 * h  # input + post-attention norms, replicated over tp
+    if facts.num_experts:
+        n_moe = L // max(facts.moe_frequency, 1)
+        n_dense = L - n_moe
+        dense_mlp = n_dense * 3.0 * h * facts.ffn / tp
+        experts = n_moe * facts.num_experts * 3.0 * h * facts.ffn / (ep * tp)
+        router = n_moe * float(h * facts.num_experts)
+    else:
+        dense_mlp = L * 3.0 * h * facts.ffn / tp
+        experts = router = 0.0
+    out = {
+        "embed": embed,
+        "layers": (L * (qkv + o + norms) + dense_mlp) / pp,
+        "experts": experts / pp,
+        "router": router / pp,
+        "final_norm": float(h),
+    }
+    if not facts.tied_embeddings:
+        out["lm_head"] = facts.vocab * h / tp
+    return out
+
+
+def params_per_device(facts: ModelFacts, plan: Plan) -> float:
+    return sum(param_components(facts, plan).values())
+
+
+# --------------------------------------------------------------------------
+# HBM model
+# --------------------------------------------------------------------------
+
+#: temp-accounting constants, calibrated against compiled
+#: ``memory_analysis()`` on tiny configs across dp/tp/pp/ep meshes
+#: (tests/test_autotune.py pins the agreement at +-15%).  The decomposition
+#: was identified by one-dimension-at-a-time sweeps: scaling ONLY num_layers,
+#: ONLY seq, ONLY width, ONLY vocab isolates each coefficient.
+#:
+#: GRAD_TRANSIENTS: param-tree-sized grad-dtype buffers live at the update
+#: peak — the microbatch grad, the accumulator carry, and the AdamW update's
+#: not-yet-donated mu/nu/param outputs.
+_GRAD_TRANSIENTS = 4.5
+#: vocab-row-sized f32 buffers per token at the CE peak (logits, softmax,
+#: one-hot/dlogits, dlogits-carry)
+_HEAD_BUFFERS = 4.0
+#: f32 score-shaped arrays live per layer under naive core attention
+#: (scores, softmax output, bwd dscores); "full" remat frees them between
+#: layers, the other policies leave them at the scheduler's peak
+_SCORE_BUFFERS = 3.0
+#: dropless-MoE routing workspace: f32 gate/up/activation rows plus
+#: gather/scatter hidden copies per routed token ([T*k, ffn] and [T*k, h])
+_MOE_ROUTE_BUFFERS = 6.0
+#: fraction of collective wire time hidden under compute (async collective
+#: fusion / per-layer gather-matmul pipelining); the remainder is exposed
+_COMMS_OVERLAP = 0.5
+#: pipeline stage-loop buffering per LOCAL layer per microbatch-token: the
+#: tick loop's stacked carries + per-tick vjp residuals.  Empirically
+#: nm-independent and IDENTICAL across schedules and remat policies on the
+#: compiled artifact (the stage functions do not fold the remat policy into
+#: the tick loop), so under pp the activation term uses the selective-shaped
+#: per-token cost times this factor — calibrated at pp=2; it over-estimates
+#: (conservative for OOM pruning) at deeper pp (docs/autotuning.md).
+_PP_STAGE_BUFFERS = 5.3
+
+
+def hbm_breakdown(facts: ModelFacts, plan: Plan,
+                  policy: Any = None) -> dict[str, float]:
+    """Per-device resident bytes by category.  ``total`` is what the planner
+    budgets against (and what the calibration test compares to XLA's
+    ``argument_size + temp_size``); the categories make PlanReports explain
+    themselves."""
+    import jax.numpy as jnp
+
+    policy = policy or _policy_for(facts)
+    pbytes = _dtype_bytes(policy.param_dtype)
+    gbytes = _dtype_bytes(policy.grad_accum_dtype)
+    obytes = _dtype_bytes(policy.optimizer_dtype)
+    abytes = _dtype_bytes(policy.compute_dtype)
+
+    n_params = params_per_device(facts, plan)
+    dp_state = plan.dp if facts.zero1 else 1
+
+    # AdamW: two moments, plus a master copy when params are low-precision
+    opt_mult = 2 + (1 if jnp.dtype(policy.param_dtype)
+                    != jnp.dtype(policy.optimizer_dtype) else 0)
+
+    tokens_mb = plan.micro_batch_size * facts.seq / plan.cp
+    sp_div = plan.tp if (facts.sequence_parallel and plan.tp > 1) else 1
+    h, ffn, d = facts.hidden, facts.ffn, facts.head_dim
+    nh, nkv = facts.num_heads, facts.num_kv_heads
+    layers_local = facts.num_layers / plan.pp
+
+    # residual bytes saved per token per layer, by remat policy: "full"
+    # keeps only the scan carry (the layer input); "selective" additionally
+    # keeps the projection/MLP intermediates but recomputes the attention
+    # core; "none" keeps everything the backward reads
+    qkv_width = (nh + 2 * nkv) * d / plan.tp
+    is_moe = bool(facts.num_experts)
+    mlp_width = (facts.top_k if is_moe and facts.moe_frequency == 1
+                 else 1) * ffn / plan.tp
+    remat = "selective" if plan.pp > 1 else plan.remat  # pp ignores remat
+    if remat == "full":
+        c_tok = (h / sp_div) * abytes
+    elif remat == "selective":
+        c_tok = (2.0 * h / sp_div + qkv_width + 2.0 * mlp_width) * abytes
+    else:
+        c_tok = (3.0 * h / sp_div + qkv_width + 2 * nh * d / plan.tp
+                 + 3.0 * mlp_width) * abytes
+    # naive core attention materializes [b, nh/tp, s/cp, s] f32 scores; flash
+    # (a real kernel on TPU) tiles them away.  "full" remat frees them
+    # between layers; the other policies keep them at the scheduler's peak.
+    impl = getattr(facts.model_cfg, "attention_impl",
+                   getattr(getattr(facts.model_cfg, "llama", None),
+                           "attention_impl", "core"))
+    if impl == "core" and remat != "full":
+        c_tok += _SCORE_BUFFERS * (nh / plan.tp) * (facts.seq / plan.cp) * 4
+    if is_moe:
+        # dropless routing workspace rides every MoE layer in f32
+        moe_share = 1.0 / max(facts.moe_frequency, 1)
+        c_tok += _MOE_ROUTE_BUFFERS * moe_share * max(facts.top_k, 1) \
+            * (ffn + h) / plan.tp * 4
+
+    act = layers_local * c_tok * tokens_mb
+    if plan.pp > 1:
+        # asymptotic in-flight residency: 1F1B drains a microbatch's
+        # residuals after at most pp ticks, the autodiff wavefront holds
+        # every microbatch's forward until its backward arrives.  At tiny
+        # depths/counts the stage loop's own fixed buffering dominates (the
+        # calibrated floor — compiled temps there are nm- and
+        # schedule-independent); max() keeps the floor AND the asymptote.
+        in_flight = (min(plan.pp, plan.num_microbatches)
+                     if plan.schedule == "1f1b" else plan.num_microbatches)
+        act *= max(_PP_STAGE_BUFFERS, float(in_flight))
+
+    logits = _HEAD_BUFFERS * tokens_mb * facts.vocab / plan.tp * 4
+    batch = (facts.global_batch_size / plan.dp) * facts.seq * 4 * 2
+
+    out = {
+        "params": n_params * pbytes,
+        "grads": _GRAD_TRANSIENTS * n_params * gbytes,
+        "opt_state": opt_mult * n_params * obytes / dp_state,
+        "batch": batch,
+        "activations": act,
+        "logits": logits,
+    }
+    if facts.num_experts and plan.ep > 1:
+        # dropless MoE computes against the ep-GATHERED expert weights
+        # (ops/moe.py weight-gather EP); the gathered copy is a transient
+        comp = param_components(facts, plan)
+        out["gathered_experts"] = comp["experts"] * plan.ep * abytes
+    out["total"] = sum(out.values())
+    return out
+
+
+def estimate_hbm_bytes(facts: ModelFacts, plan: Plan,
+                       policy: Any = None) -> float:
+    return hbm_breakdown(facts, plan, policy)["total"]
+
+
+# --------------------------------------------------------------------------
+# time model
+# --------------------------------------------------------------------------
+
+
+def _ring_seconds(bytes_full: float, n: int, topo: ChipTopology,
+                  *, allreduce: bool = False, hops: Optional[int] = None
+                  ) -> float:
+    """Ring-collective time for a ``bytes_full``-sized logical tensor over
+    ``n`` ranks: all-gather/reduce-scatter move ``B(n-1)/n`` per rank,
+    all-reduce twice that."""
+    if n <= 1 or bytes_full <= 0:
+        return 0.0
+    factor = 2.0 if allreduce else 1.0
+    wire = factor * bytes_full * (n - 1) / (n * topo.ici_bandwidth_bytes)
+    return wire + (hops if hops is not None else factor * (n - 1)) \
+        * topo.ici_latency_seconds
+
+
+@dataclasses.dataclass
+class PlanEstimate:
+    """The cost model's verdict on one plan (seconds / bytes, per step)."""
+
+    compute_seconds: float
+    comms_seconds: float
+    bubble_seconds: float
+    hbm_bytes: float
+    comms_breakdown: dict[str, float]
+    hbm_breakdown: dict[str, float]
+    fits: bool = True
+
+    @property
+    def step_seconds(self) -> float:
+        return self.compute_seconds + self.comms_seconds + self.bubble_seconds
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "step_seconds": round(self.step_seconds, 6),
+            "compute_seconds": round(self.compute_seconds, 6),
+            "comms_seconds": round(self.comms_seconds, 6),
+            "bubble_seconds": round(self.bubble_seconds, 6),
+            "hbm_bytes": int(self.hbm_bytes),
+            "fits": self.fits,
+            "comms_breakdown": {k: round(v, 6)
+                                for k, v in self.comms_breakdown.items()},
+            "hbm_breakdown": {k: int(v)
+                              for k, v in self.hbm_breakdown.items()},
+        }
+
+
+def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
+                  *, hbm_headroom: float = 0.9) -> PlanEstimate:
+    """Score one plan.  ``fits`` is False when the HBM estimate exceeds
+    ``hbm_headroom`` x the topology's capacity (the runtime and fragmentation
+    own the rest)."""
+    from neuronx_distributed_training_tpu.utils.perf import (
+        flops_breakdown_for_model,
+    )
+
+    policy = _policy_for(facts)
+    abytes = _dtype_bytes(policy.compute_dtype)
+    chips = plan.world
+    bd = flops_breakdown_for_model(facts.model_cfg, facts.seq)
+    fwd = sum(bd.values())
+    # attention core (score/context) FLOPs — what "selective" recomputes
+    core = 2.0 * facts.seq * facts.num_heads * facts.head_dim \
+        * facts.num_layers  # causal-halved scores+context per token
+    step_flops_tok = 3.0 * fwd
+    if plan.remat == "full":
+        step_flops_tok += fwd          # one full extra forward in bwd
+    elif plan.remat == "selective":
+        step_flops_tok += core
+    total_flops = facts.global_batch_size * facts.seq * step_flops_tok
+    compute = total_flops / (chips * topo.peak_flops
+                             * topo.compute_efficiency)
+
+    # ---- comms ----
+    tokens_chip = facts.global_batch_size * facts.seq / (plan.dp * plan.cp)
+    h = facts.hidden
+    comms: dict[str, float] = {}
+
+    # tp: per layer, fwd+bwd move ~4 gathered-activation volumes each way
+    # (SP's AG/RS pairs; plain TP's all-reduces cost the same wire bytes)
+    if plan.tp > 1:
+        per_layer_bytes = 4.0 * tokens_chip * h * abytes
+        comms["tp"] = 2.0 * facts.num_layers / plan.pp * _ring_seconds(
+            per_layer_bytes, plan.tp, topo)
+        # vocab-parallel CE: two tiny [tokens] all-reduces per microbatch
+        comms["tp"] += plan.num_microbatches * _ring_seconds(
+            2.0 * tokens_chip / plan.num_microbatches * 4, plan.tp, topo,
+            allreduce=True)
+
+    # dp: ZeRO-1 reduce-scatter(grads f32) + all-gather(params); plain dp
+    # all-reduces grads
+    if plan.dp > 1:
+        grad_bytes = params_per_device(facts, plan) \
+            * _dtype_bytes(policy.reduce_dtype)
+        if facts.zero1:
+            comms["dp"] = _ring_seconds(grad_bytes, plan.dp, topo) \
+                + _ring_seconds(
+                    params_per_device(facts, plan)
+                    * _dtype_bytes(policy.param_dtype), plan.dp, topo)
+        else:
+            comms["dp"] = _ring_seconds(grad_bytes, plan.dp, topo,
+                                        allreduce=True)
+
+    # pp: 2*nm point-to-point hidden hops per chip (fwd + bwd)
+    if plan.pp > 1:
+        hop = plan.micro_batch_size * (facts.seq / plan.cp) * h * abytes
+        comms["pp"] = 2.0 * plan.num_microbatches * (
+            hop / topo.ici_bandwidth_bytes + topo.ici_latency_seconds)
+
+    # cp: ring kv passes (ring/zigzag) or qkvo all-to-alls (ulysses),
+    # fwd + 2x bwd
+    if plan.cp > 1:
+        kv_bytes = 2.0 * tokens_chip * facts.num_kv_heads * facts.head_dim \
+            * abytes
+        if facts.cp_fusion == "ulysses":
+            a2a = 2.0 * tokens_chip * h * abytes
+            comms["cp"] = 3.0 * facts.num_layers / plan.pp * _ring_seconds(
+                a2a, plan.cp, topo)
+        else:
+            comms["cp"] = 3.0 * facts.num_layers / plan.pp * _ring_seconds(
+                kv_bytes, plan.cp, topo)
+
+    # ep: token dispatch + combine all-to-alls, fwd + 2x bwd
+    if plan.ep > 1 and facts.num_experts:
+        n_moe = facts.num_layers // max(facts.moe_frequency, 1)
+        route_bytes = tokens_chip * max(facts.top_k, 1) * h * abytes
+        comms["ep"] = 3.0 * n_moe / plan.pp * _ring_seconds(
+            route_bytes, plan.ep, topo)
+
+    # XLA overlaps collectives with compute aggressively (async collective
+    # fusion; per-layer SP gathers hide under the matmuls that consume
+    # them), so only a fraction of the wire time is EXPOSED step time.
+    # A single factor — per-collective overlap windows are a documented
+    # blind spot of the analytic ranking (docs/autotuning.md).
+    comms = {k: v * (1.0 - _COMMS_OVERLAP) for k, v in comms.items()}
+    comms_total = sum(comms.values())
+
+    # ---- bubble ----
+    bubble = 0.0
+    if plan.pp > 1 and plan.num_microbatches > 0:
+        inner = compute + comms_total - comms.get("dp", 0.0)
+        bubble = (plan.pp - 1) / plan.num_microbatches * inner
+
+    mem = hbm_breakdown(facts, plan, policy)
+    fits = mem["total"] <= hbm_headroom * topo.hbm_bytes
+    return PlanEstimate(
+        compute_seconds=compute, comms_seconds=comms_total,
+        bubble_seconds=bubble, hbm_bytes=mem["total"],
+        comms_breakdown=comms, hbm_breakdown=mem, fits=fits,
+    )
+
+
+# --------------------------------------------------------------------------
+# rank agreement (bench.py --plan-topk)
+# --------------------------------------------------------------------------
+
+
+def kendall_tau(a: list[float], b: list[float]) -> Optional[float]:
+    """Kendall rank correlation between two paired score lists (tau-a; ties
+    count as discordant-neutral).  None for fewer than 2 pairs."""
+    n = min(len(a), len(b))
+    if n < 2:
+        return None
+    conc = disc = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            da = a[i] - a[j]
+            db = b[i] - b[j]
+            s = da * db
+            if s > 0:
+                conc += 1
+            elif s < 0:
+                disc += 1
+    total = n * (n - 1) / 2
+    return (conc - disc) / total

@@ -362,6 +362,47 @@ def validate_config(cfg: ConfigDict) -> None:
             f"mixed_precision, bf16SR, autocast, fp32, manual"
         )
 
+    # ---- autotune ---------------------------------------------------------
+    # the compile-time launch planner's knob block (docs/autotuning.md):
+    # root-level ``autotune: {enabled, top_k, topology, hbm_headroom,
+    # max_micro_batch_size}``.  Validated here so a typo'd knob dies at load,
+    # not silently mid-plan; the planner itself re-reads the block.
+    at = cfg.get("autotune", None)
+    if at is not None:
+        if not isinstance(at, Mapping):
+            raise ValueError(
+                f"autotune must be a mapping of knobs (enabled/top_k/"
+                f"topology/hbm_headroom/max_micro_batch_size), got "
+                f"{type(at).__name__}: {at!r}"
+            )
+        _AT_KEYS = {"enabled", "top_k", "topology", "hbm_headroom",
+                    "max_micro_batch_size"}
+        unknown = set(at) - _AT_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown autotune keys {sorted(unknown)}; supported: "
+                f"{sorted(_AT_KEYS)}" + did_you_mean(unknown, _AT_KEYS)
+            )
+        if "top_k" in at and int(at["top_k"]) < 1:
+            raise ValueError(f"autotune.top_k must be >= 1, got {at['top_k']}")
+        if "hbm_headroom" in at:
+            hr = float(at["hbm_headroom"])
+            if not 0.0 < hr <= 1.0:
+                raise ValueError(
+                    f"autotune.hbm_headroom must be in (0, 1], got {hr}"
+                )
+        if at.get("topology") is not None:
+            from neuronx_distributed_training_tpu.autotune.topology import (
+                TOPOLOGIES,
+            )
+
+            if str(at["topology"]).lower() not in TOPOLOGIES:
+                raise ValueError(
+                    f"unknown autotune.topology {at['topology']!r}; known: "
+                    f"{'/'.join(sorted(TOPOLOGIES))}"
+                    + did_you_mean([at["topology"]], TOPOLOGIES)
+                )
+
     # ---- exp_manager.telemetry -------------------------------------------
     # the unified step-telemetry knob block (spans/mfu/compile_census/
     # device_memory/goodput) plus the nested ``health`` flight-recorder block

@@ -85,6 +85,15 @@ def main() -> None:
                          "THIS config at true size on this machine's "
                          "devices, then exit non-zero on error findings — "
                          "no params materialized, no data opened")
+    ap.add_argument("--autotune", nargs="?", const=0, type=int, default=None,
+                    metavar="TOP_K",
+                    help="plan the launch config before materializing "
+                         "(autotune planner, docs/autotuning.md): rank the "
+                         "legal tp/pp/cp/ep/mbs/remat/schedule lattice for "
+                         "THIS machine's chip count, audit the top "
+                         "candidates, impose the winner on the config, and "
+                         "record the plan in run_summary.json.  Optional "
+                         "value overrides autotune.top_k")
     ap.add_argument("--compilation-cache", default=os.environ.get(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/nxdt_xla_cache"),
         help="persistent XLA compilation cache dir")
@@ -126,7 +135,42 @@ def main() -> None:
 
     cfg = load_config(args.config, overrides)
 
+    # -- autotune: plan BEFORE materializing (no params, no data yet) ------
+    plan_report = None
+    at_block = dict(cfg.get("autotune", {}) or {})
+    if args.autotune is not None or at_block.get("enabled"):
+        from neuronx_distributed_training_tpu.autotune import plan_config
+
+        top_k = (args.autotune if (args.autotune or 0) > 0
+                 else int(at_block.get("top_k", 5)))
+        chips = len(jax.devices())
+        plan_report = plan_config(
+            cfg, chips=chips,
+            topology=at_block.get("topology"),
+            top_k=top_k,
+            hbm_headroom=float(at_block.get("hbm_headroom", 0.9)),
+            max_mbs=int(at_block.get("max_micro_batch_size", 8)),
+            max_devices=min(8, chips),
+        )
+        print(plan_report.format())
+        winner = plan_report.winner
+        if winner is None:
+            raise SystemExit(
+                f"autotune: no surviving plan for {chips} chips"
+                + (f" ({plan_report.error})" if plan_report.error else "")
+            )
+        logger.info("autotune: imposing %s", winner.plan.describe())
+        cfg = load_config(
+            args.config,
+            {**overrides, **winner.plan.overrides(plan_report.facts)},
+        )
+
     trainer = Trainer.from_config(cfg, enable_checkpointing=not args.compile_only)
+    if plan_report is not None:
+        # the chosen plan becomes a static run fact: the compile census
+        # carries it, and run_summary.json gets the full ranked report
+        trainer.run_facts["autotune_plan"] = plan_report.winner.plan.describe()
+        trainer.exp.write_run_summary({"autotune": plan_report.to_dict()})
 
     if args.compile_only:
         from neuronx_distributed_training_tpu.parallel import sharding as shd

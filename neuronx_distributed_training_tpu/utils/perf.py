@@ -147,6 +147,108 @@ def flops_for_config(model_cfg: Any, seq_len: int) -> float:
     )
 
 
+#: component keys of the per-token FLOPs breakdown, in reporting order
+FLOPS_COMPONENTS = ("attention", "mlp", "router", "head")
+
+
+def _attention_flops_per_token(
+    *, hidden_size: int, num_attention_heads: int, num_kv_heads: int | None,
+    seq_len: int, head_dim: int | None = None,
+    include_causal_half: bool = True,
+) -> float:
+    """Per-layer attention FLOPs/token: qkv + o projections + the causal
+    score/context matmuls — the Llama accounting with the MLP term removed."""
+    h = hidden_size
+    d = head_dim or h // num_attention_heads
+    nh = num_attention_heads
+    nkv = num_kv_heads or nh
+    qkv = 2 * h * (nh + 2 * nkv) * d
+    o = 2 * nh * d * h
+    attn_scores = 2 * seq_len * nh * d
+    attn_context = 2 * seq_len * nh * d
+    if include_causal_half:
+        attn_scores /= 2
+        attn_context /= 2
+    return qkv + o + attn_scores + attn_context
+
+
+def flops_breakdown_for_model(model_cfg: Any, seq_len: int) -> dict[str, float]:
+    """Per-component fwd FLOPs/token for ANY supported family:
+    ``{attention, mlp, router, head}`` (``FLOPS_COMPONENTS``).
+
+    The autotune cost model consumes this shape directly (each component
+    scales differently under tp/cp/remat); ``flops_for_model`` is exactly its
+    sum, so the scalar and the breakdown cannot drift apart.  Conventions are
+    the MFU ones: mixtral/GPT-MoE count only ACTIVATED expert FLOPs + the
+    router matmul; GPT honors its GLU-vs-plain activation; causal masking
+    halves the score/context term.
+    """
+    from neuronx_distributed_training_tpu.models import gpt as _gpt
+    from neuronx_distributed_training_tpu.models import mixtral as _mx
+
+    if isinstance(model_cfg, _mx.MixtralConfig):
+        lc = model_cfg.llama
+        attn = lc.num_layers * _attention_flops_per_token(
+            hidden_size=lc.hidden_size,
+            num_attention_heads=lc.num_attention_heads,
+            num_kv_heads=lc.num_kv_heads,
+            seq_len=seq_len,
+            head_dim=getattr(lc, "head_dim", None),
+        )
+        n_moe = _mx.num_moe_layers(model_cfg)
+        n_dense = lc.num_layers - n_moe
+        swiglu = 2 * lc.hidden_size * 3 * lc.intermediate_size
+        router = 2 * lc.hidden_size * model_cfg.moe.num_experts
+        return {
+            "attention": attn,
+            "mlp": n_dense * swiglu + n_moe * model_cfg.moe.top_k * swiglu,
+            "router": float(n_moe * router),
+            "head": 2.0 * lc.hidden_size * lc.vocab_size,
+        }
+    if isinstance(model_cfg, _gpt.GPTConfig):
+        attn = model_cfg.num_layers * _attention_flops_per_token(
+            hidden_size=model_cfg.hidden_size,
+            num_attention_heads=model_cfg.num_attention_heads,
+            num_kv_heads=model_cfg.kv_heads,
+            seq_len=seq_len,
+            head_dim=model_cfg.head_size,
+        )
+        matmuls = 3 if model_cfg.is_glu else 2  # (gate,) up, down
+        mlp = 2 * model_cfg.hidden_size * matmuls * model_cfg.ffn_size
+        head = 2.0 * model_cfg.hidden_size * model_cfg.vocab_size
+        if model_cfg.moe is not None:
+            n_moe = _gpt.num_moe_layers(model_cfg)
+            n_dense = model_cfg.num_layers - n_moe
+            router = 2 * model_cfg.hidden_size * model_cfg.moe.num_experts
+            return {
+                "attention": attn,
+                "mlp": n_dense * mlp + n_moe * model_cfg.moe.top_k * mlp,
+                "router": float(n_moe * router),
+                "head": head,
+            }
+        return {
+            "attention": attn,
+            "mlp": float(model_cfg.num_layers * mlp),
+            "router": 0.0,
+            "head": head,
+        }
+    # llama/mistral (and anything exposing the same shape attributes)
+    attn = model_cfg.num_layers * _attention_flops_per_token(
+        hidden_size=model_cfg.hidden_size,
+        num_attention_heads=model_cfg.num_attention_heads,
+        num_kv_heads=getattr(model_cfg, "num_kv_heads", None),
+        seq_len=seq_len,
+        head_dim=getattr(model_cfg, "head_dim", None),
+    )
+    mlp = 2 * model_cfg.hidden_size * 3 * model_cfg.intermediate_size
+    return {
+        "attention": attn,
+        "mlp": float(model_cfg.num_layers * mlp),
+        "router": 0.0,
+        "head": 2.0 * model_cfg.hidden_size * model_cfg.vocab_size,
+    }
+
+
 def flops_for_model(model_cfg: Any, seq_len: int) -> float:
     """fwd FLOPs/token for ANY supported model family — the MFU dispatch.
 
@@ -155,49 +257,8 @@ def flops_for_model(model_cfg: Any, seq_len: int) -> float:
     megatron GPT swaps SwiGLU for its configured activation (GLU: 3 matmuls,
     plain: 2) and honors optional MoE.  Only ACTIVATED expert FLOPs count —
     MFU measures useful work per token, and an unrouted expert does none.
-    """
-    from neuronx_distributed_training_tpu.models import gpt as _gpt
-    from neuronx_distributed_training_tpu.models import mixtral as _mx
 
-    if isinstance(model_cfg, _mx.MixtralConfig):
-        lc = model_cfg.llama
-        # attention + logits from the llama model with the MLP term zeroed
-        base = llama_flops_per_token(
-            num_layers=lc.num_layers,
-            hidden_size=lc.hidden_size,
-            intermediate_size=0,
-            num_attention_heads=lc.num_attention_heads,
-            num_kv_heads=lc.num_kv_heads,
-            vocab_size=lc.vocab_size,
-            seq_len=seq_len,
-            head_dim=getattr(lc, "head_dim", None),
-        )
-        n_moe = _mx.num_moe_layers(model_cfg)
-        n_dense = lc.num_layers - n_moe
-        swiglu = 2 * lc.hidden_size * 3 * lc.intermediate_size
-        router = 2 * lc.hidden_size * model_cfg.moe.num_experts
-        return (base
-                + n_dense * swiglu
-                + n_moe * (model_cfg.moe.top_k * swiglu + router))
-    if isinstance(model_cfg, _gpt.GPTConfig):
-        base = llama_flops_per_token(
-            num_layers=model_cfg.num_layers,
-            hidden_size=model_cfg.hidden_size,
-            intermediate_size=0,
-            num_attention_heads=model_cfg.num_attention_heads,
-            num_kv_heads=model_cfg.kv_heads,
-            vocab_size=model_cfg.vocab_size,
-            seq_len=seq_len,
-            head_dim=model_cfg.head_size,
-        )
-        matmuls = 3 if model_cfg.is_glu else 2  # (gate,) up, down
-        mlp = 2 * model_cfg.hidden_size * matmuls * model_cfg.ffn_size
-        if model_cfg.moe is not None:
-            n_moe = _gpt.num_moe_layers(model_cfg)
-            n_dense = model_cfg.num_layers - n_moe
-            router = 2 * model_cfg.hidden_size * model_cfg.moe.num_experts
-            return (base + n_dense * mlp
-                    + n_moe * (model_cfg.moe.top_k * mlp + router))
-        return base + model_cfg.num_layers * mlp
-    # llama/mistral (and anything exposing the same shape attributes)
-    return flops_for_config(model_cfg, seq_len)
+    The scalar IS the sum of ``flops_breakdown_for_model`` — one accounting,
+    two granularities.
+    """
+    return float(sum(flops_breakdown_for_model(model_cfg, seq_len).values()))

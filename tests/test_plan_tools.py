@@ -1,0 +1,126 @@
+"""tools/plan.py CLI + the shared tools/_jsonout.py writer.
+
+The _jsonout contract under test is the satellite fix: with ``--json -`` the
+LAST stdout line is exactly one parseable JSON document, even when logging
+warnings are emitted mid-run (previously a stray log line could land after
+the payload).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+TINY = os.path.join(REPO, "examples/conf/tiny_smoke_config.yaml")
+
+sys.path.insert(0, TOOLS)
+
+
+# ---------------------------------------------------------------------------
+# _jsonout: the single-parseable-last-line contract
+# ---------------------------------------------------------------------------
+
+
+class TestJsonOut:
+    def test_stdout_payload_is_single_last_line(self, capsys):
+        from _jsonout import write_json
+
+        # a logging handler writing to stdout — the failure mode the shared
+        # writer exists to defeat (buffered log line landing after the JSON)
+        logger = logging.getLogger("jsonout-test")
+        handler = logging.StreamHandler(sys.stdout)
+        logger.addHandler(handler)
+        try:
+            logger.warning("a stray warning before the payload")
+            write_json({"ok": 1, "nested": {"a": [1, 2]}}, "-")
+        finally:
+            logger.removeHandler(handler)
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if ln]
+        assert json.loads(lines[-1]) == {"ok": 1, "nested": {"a": [1, 2]}}
+        # the payload is ONE line (compact form), not a pretty-printed block
+        assert lines[-1].startswith("{") and lines[-1].endswith("}")
+
+    def test_file_payload_parses_whole_file(self, tmp_path):
+        from _jsonout import write_json
+
+        p = tmp_path / "out.json"
+        write_json({"reports": [1, 2]}, str(p))
+        assert json.loads(p.read_text()) == {"reports": [1, 2]}
+
+    def test_flush_streams_is_safe_without_handlers(self):
+        from _jsonout import flush_streams
+
+        flush_streams()  # must never raise
+
+
+def run_tool(args, timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the tools size their own device world
+    return subprocess.run(
+        [sys.executable] + args, capture_output=True, text=True,
+        timeout=timeout, cwd=REPO, env=env,
+    )
+
+
+# ---------------------------------------------------------------------------
+# tools/plan.py
+# ---------------------------------------------------------------------------
+
+
+class TestPlanCLI:
+    def test_check_tiny_smoke_passes_and_last_line_is_json(self):
+        r = run_tool([os.path.join(TOOLS, "plan.py"), "--config", TINY,
+                      "--check", "--json", "-"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [ln for ln in r.stdout.splitlines() if ln]
+        payload = json.loads(lines[-1])
+        assert payload["check"][0]["ok"] is True
+        assert payload["check"][0]["config"] == "tiny_smoke_config.yaml"
+
+    def test_plan_with_audit_emits_report_and_applies(self, tmp_path):
+        out_yaml = tmp_path / "tuned.yaml"
+        out_json = tmp_path / "plan.json"
+        r = run_tool([os.path.join(TOOLS, "plan.py"), "--config", TINY,
+                      "--chips", "8", "--topology", "cpu", "--top-k", "2",
+                      "--apply", str(out_yaml), "--json", str(out_json)])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "winning knob block" in r.stdout
+        payload = json.loads(out_json.read_text())
+        rep = payload["reports"][0]
+        assert rep["winner"] is not None
+        # every non-discarded candidate passed the graph audit
+        for c in rep["candidates"]:
+            if "discarded" not in c:
+                assert c["audit"]["verdict"] in ("clean", "info", "warn")
+        # the applied copy loads and declares the winning mesh
+        import yaml
+
+        tuned = yaml.safe_load(out_yaml.read_text())
+        assert (tuned["distributed_strategy"]["tensor_model_parallel_size"]
+                == rep["winner"]["tp"])
+
+    def test_nothing_to_do_errors(self):
+        r = run_tool([os.path.join(TOOLS, "plan.py")], timeout=60)
+        assert r.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# tools/preflight_audit.py rides the same writer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestPreflightJsonLastLine:
+    def test_last_stdout_line_is_json(self):
+        r = run_tool([os.path.join(TOOLS, "preflight_audit.py"),
+                      "--config", TINY, "--json", "-"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [ln for ln in r.stdout.splitlines() if ln]
+        payload = json.loads(lines[-1])
+        assert payload["reports"][0]["config"] == "tiny_smoke_config.yaml"

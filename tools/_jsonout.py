@@ -1,0 +1,54 @@
+"""Shared machine-readable JSON emission for the tools/ CLIs.
+
+Contract: when a tool is asked for JSON on stdout (``--json -``), the LAST
+line of stdout is exactly one parseable JSON document — no logging line,
+warning, or partial flush may land after it.  ``write_json`` enforces that
+by flushing every logging handler and stderr BEFORE printing, and printing
+the payload as a single compact line with its own flush.  File targets get
+the indented form (humans read those).
+
+Consumers: ``tools/preflight_audit.py --json`` and ``tools/plan.py --json``
+(CI parses both with ``tail -1 | python -m json.tool``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any
+
+
+def flush_streams() -> None:
+    """Flush every logging handler + both std streams so buffered diagnostics
+    cannot be interleaved after (or into) the JSON payload line."""
+    for logger in [logging.getLogger()] + [
+        logging.getLogger(name) for name in logging.root.manager.loggerDict
+    ]:
+        for handler in getattr(logger, "handlers", []):
+            try:
+                handler.flush()
+            except Exception:  # noqa: BLE001 — best-effort, emission must win
+                pass
+    try:
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        sys.stdout.flush()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def write_json(payload: Any, path: str) -> None:
+    """Write ``payload`` to ``path`` (``-`` = stdout).
+
+    Stdout form: ONE compact line, guaranteed last (streams flushed first).
+    File form: indented + trailing newline, parseable as a whole file.
+    """
+    if path == "-":
+        flush_streams()
+        print(json.dumps(payload, sort_keys=False), flush=True)
+        return
+    with open(path, "w") as f:
+        f.write(json.dumps(payload, indent=1) + "\n")

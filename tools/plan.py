@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Launch planner CLI — pick tp/pp/cp/ep/dp, microbatching, remat, and the
+pipeline schedule for a config BEFORE spending a chip-hour.
+
+Built on ``neuronx_distributed_training_tpu.autotune`` (docs/autotuning.md):
+enumerate the legal plan lattice, rank it with the analytic roofline, then
+AOT-lower the top-k candidates SHRUNK (graph-audit structure checks + real
+collective census + measured memory) and print the PlanReport.
+
+Usage:
+
+    python tools/plan.py --config examples/conf/hf_llama3_8B_config.yaml \
+        --chips 256 --topology v5e --top-k 5
+    python tools/plan.py --config cfg.yaml --chips 64 --apply tuned.yaml
+    python tools/plan.py --all-examples --check        # CI gate
+    python tools/plan.py --config cfg.yaml --json -    # last line = JSON
+
+``--check`` (the verify-flow gate): for every config, the DECLARED
+parallelism must appear among the planner's top-3 mesh factorizations for
+its chip count — or the YAML must carry an explicit waiver comment
+(``# autotune-waiver: <reason>``).  Keeps shipped examples and the cost
+model from diverging silently; analytic-only, no lowering.
+
+Exit code 1 when --check fails (or a plan errors).  ``--json`` writes the
+full machine-readable report via the shared ``tools/_jsonout.py`` writer:
+with ``--json -`` the LAST stdout line is guaranteed parseable JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+
+def _example_configs() -> list[str]:
+    import glob
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return sorted(glob.glob(os.path.join(here, "examples/conf/*.yaml")))
+
+
+def _declared_chips(path: str) -> int:
+    """Chip count a config is written for: ``trainer.devices`` when present,
+    else the smallest world its declared degrees admit (dp = ep)."""
+    import yaml
+
+    from neuronx_distributed_training_tpu.config import loader as _loader
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    raw = _loader._resolve_tree(raw, raw)
+    devices = int((raw.get("trainer") or {}).get("devices", 0) or 0)
+    if devices:
+        return devices
+    ds = dict(raw.get("distributed_strategy") or {})
+
+    def deg(key):
+        try:
+            return max(int(ds.get(key) or 1), 1)
+        except (TypeError, ValueError):
+            return 1
+
+    return (deg("tensor_model_parallel_size")
+            * deg("pipeline_model_parallel_size")
+            * deg("context_parallel_size")
+            * deg("expert_model_parallel_size"))
+
+
+def _waiver(path: str) -> str | None:
+    """The config's ``# autotune-waiver: <reason>`` comment, if any.
+
+    Only a COMMENT whose body starts with the marker counts — an incidental
+    mention in a doc string or quoted value must not disable the gate."""
+    with open(path) as f:
+        for line in f:
+            stripped = line.lstrip()
+            if not stripped.startswith("#"):
+                continue
+            body = stripped.lstrip("#").strip()
+            if body.startswith("autotune-waiver:"):
+                return body.split("autotune-waiver:", 1)[1].strip()
+    return None
+
+
+def check_config(path: str, *, top_meshes: int = 3,
+                 slack: float = 1.10) -> dict:
+    """--check: the declared parallelism must be among the planner's top-N
+    mesh factorizations for this config's chip count, OR within ``slack`` x
+    the best plan's predicted step time (a near-tie between factorizations
+    is agreement, not divergence), OR carry a waiver comment."""
+    from neuronx_distributed_training_tpu.autotune import plan_config
+
+    chips = _declared_chips(path)
+    rep = plan_config(path, chips=chips, topology=None, audit=False,
+                      top_k=10**9)
+    name = os.path.basename(path)
+    if rep.error:
+        return {"config": name, "chips": chips, "ok": False,
+                "reason": rep.error}
+    declared = rep.facts.declared_plan_for(chips) if rep.facts else None
+    if declared is None:
+        return {"config": name, "chips": chips, "ok": False,
+                "reason": "declared degrees do not divide the chip count"}
+    # rank distinct MESHES by their best plan (remat/mbs/schedule collapse)
+    meshes: list[tuple] = []
+    best_of_mesh: dict[tuple, float] = {}
+    for c in rep.candidates:
+        if c.plan.mesh not in best_of_mesh:
+            meshes.append(c.plan.mesh)
+            best_of_mesh[c.plan.mesh] = c.estimate.step_seconds
+    try:
+        mesh_rank = meshes.index(declared.mesh) + 1
+    except ValueError:
+        mesh_rank = None
+    best = rep.candidates[0].estimate.step_seconds if rep.candidates else 0.0
+    ratio = (best_of_mesh[declared.mesh] / best
+             if mesh_rank is not None and best > 0 else None)
+    ok = mesh_rank is not None and (mesh_rank <= top_meshes
+                                    or (ratio is not None
+                                        and ratio <= slack))
+    out = {"config": name, "chips": chips, "ok": ok,
+           "declared_mesh": dict(zip(("tp", "pp", "cp", "ep", "dp"),
+                                     declared.mesh)),
+           "mesh_rank": mesh_rank,
+           "vs_best": round(ratio, 3) if ratio is not None else None,
+           "top_meshes": [dict(zip(("tp", "pp", "cp", "ep", "dp"), m))
+                          for m in meshes[:top_meshes]]}
+    if not ok:
+        waiver = _waiver(path)
+        if waiver:
+            out["ok"] = True
+            out["waiver"] = waiver
+        else:
+            out["reason"] = (
+                f"declared mesh ranks "
+                f"{mesh_rank if mesh_rank else 'outside the lattice'} "
+                f"(> top-{top_meshes}"
+                + (f", {ratio:.2f}x the best plan" if ratio else "")
+                + f"); add an '# autotune-waiver: <why>' comment or "
+                  f"revisit the config's parallelism"
+            )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--config", action="append", default=[],
+                    help="YAML config to plan for (repeatable)")
+    ap.add_argument("--all-examples", action="store_true",
+                    help="plan every examples/conf/*.yaml")
+    ap.add_argument("--chips", type=int, default=None,
+                    help="chip count to plan for (default: the config's "
+                         "trainer.devices, else its declared degrees)")
+    ap.add_argument("--topology", default=None,
+                    help="ICI/HBM table to price against "
+                         "(v4/v5e/v5p/v6e/cpu; default: detect from the "
+                         "local device)")
+    ap.add_argument("--top-k", type=int, default=5,
+                    help="candidates to audit + report (default 5)")
+    ap.add_argument("--no-audit", dest="audit", action="store_false",
+                    help="analytic ranking only — skip the shrunk AOT "
+                         "lowering of the top-k")
+    ap.add_argument("--max-mbs", type=int, default=8,
+                    help="largest micro_batch_size the lattice explores")
+    ap.add_argument("--hbm-headroom", type=float, default=0.9,
+                    help="fraction of topology HBM the plan may fill")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: declared parallelism must be in the "
+                         "planner's top-3 meshes (or carry an "
+                         "'# autotune-waiver:' comment)")
+    ap.add_argument("--apply", metavar="OUT_YAML",
+                    help="write a copy of the (single) config with the "
+                         "winning knobs imposed")
+    ap.add_argument("--json", metavar="PATH",
+                    help="machine-readable report ('-' for stdout; the "
+                         "payload is the guaranteed-last line)")
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"],
+                    help="jax platform for the audit lowerings (default "
+                         "cpu: planning is static)")
+    args = ap.parse_args()
+
+    configs = list(args.config)
+    if args.all_examples:
+        configs += _example_configs()
+    if not configs:
+        ap.error("nothing to do: pass --config and/or --all-examples")
+    if args.apply and len(configs) != 1:
+        ap.error("--apply works on exactly one --config")
+
+    # Size the virtual CPU world BEFORE jax initializes: shrunk audits clamp
+    # every degree to 2, so 16 covers tp x pp x cp x ep all active at once.
+    if args.platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count=16"
+            ).strip()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from neuronx_distributed_training_tpu.autotune import plan_config
+
+    failed = False
+    out: dict = {}
+
+    if args.check:
+        results = [check_config(p) for p in configs]
+        for r in results:
+            mark = "ok" if r["ok"] else "FAIL"
+            extra = (f" (waiver: {r['waiver']})" if r.get("waiver")
+                     else (f" — {r['reason']}" if not r["ok"] else ""))
+            rank = r.get("mesh_rank")
+            print(f"[{mark:4s}] {r['config']} chips={r['chips']} "
+                  f"mesh_rank={rank}{extra}")
+            failed |= not r["ok"]
+        n_ok = sum(1 for r in results if r["ok"])
+        print(f"plan --check: {n_ok}/{len(results)} configs consistent "
+              f"with the planner (top-3 meshes or waived)")
+        out["check"] = results
+    else:
+        out["reports"] = []
+        for path in configs:
+            rep = plan_config(
+                path, chips=args.chips, topology=args.topology,
+                top_k=args.top_k, audit=args.audit,
+                hbm_headroom=args.hbm_headroom, max_mbs=args.max_mbs,
+                max_devices=min(16, len(jax.devices())),
+            )
+            print(rep.format(top=args.top_k))
+            print()
+            out["reports"].append(rep.to_dict())
+            failed |= rep.error is not None or rep.winner is None
+            if args.apply and rep.winner is not None:
+                from neuronx_distributed_training_tpu.autotune.planner import (
+                    apply_plan,
+                )
+
+                apply_plan(path, args.apply, rep.winner.plan, rep.facts)
+                print(f"applied winning plan -> {args.apply}")
+
+    if args.json:
+        from _jsonout import write_json
+
+        write_json(out, args.json)
+
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

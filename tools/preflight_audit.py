@@ -37,6 +37,7 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
 
 
 def _required_world(config_paths: list[str], shrink: bool) -> int:
@@ -171,12 +172,12 @@ def main() -> None:
         }
 
     if args.json:
-        payload = json.dumps(out, indent=1)
-        if args.json == "-":
-            print(payload)
-        else:
-            with open(args.json, "w") as f:
-                f.write(payload + "\n")
+        # shared writer (tools/_jsonout.py): with --json -, the payload is
+        # guaranteed to be the single parseable LAST stdout line even when
+        # warnings/log lines were emitted along the way
+        from _jsonout import write_json
+
+        write_json(out, args.json)
 
     sys.exit(1 if failed else 0)
 
