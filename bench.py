@@ -360,13 +360,17 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
 
 
 def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
-              num_microbatches: int = 1) -> dict:
+              num_microbatches: int = 1, trace: bool = False) -> dict:
     """One timed regime run; returns {ms_per_step, tokens_per_sec, mfu}.
 
     ``mbs`` is the TOTAL rows per step; ``num_microbatches > 1`` runs the
     trainer's real grad-accumulation scan (one optimizer update per step),
     which is what the autotune cost model prices — the plan-topk sweep
-    passes it so predicted and measured steps are the same unit."""
+    passes it so predicted and measured steps are the same unit.
+    ``trace=True`` additionally captures a short device-time trace window
+    AFTER the timed loop (so profiling overhead never contaminates
+    ms_per_step) and reports measured achieved_overlap /
+    exposed_collective_seconds (telemetry.trace_analysis)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -493,6 +497,34 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
         dt = (elapsed - rtt) / steps
         log(f"bench: fetch rtt {rtt * 1e3:.0f} ms")
 
+        # optional device-time trace window, AFTER the timed loop: measured
+        # compute/comms overlap for the very executable just benchmarked
+        trace_summary = None
+        if trace:
+            import tempfile
+
+            from neuronx_distributed_training_tpu.telemetry.trace import (
+                trace_steps,
+            )
+
+            def _traced_step(i):
+                nonlocal params, opt_state, metrics
+                params, opt_state, metrics = compiled(
+                    params, opt_state, batch, key)
+                _ = float(metrics["loss"])  # flush so the trace sees the step
+
+            try:
+                trace_summary = trace_steps(
+                    _traced_step, min(3, max(steps, 1)),
+                    tempfile.mkdtemp(prefix="nxdt_bench_trace_"))
+            except Exception as e:  # noqa: BLE001 — trace must not fail the bench
+                log(f"bench: trace capture failed: {e}")
+            if trace_summary is not None:
+                log(f"bench: trace achieved_overlap="
+                    f"{trace_summary.get('achieved_overlap')} "
+                    f"exposed_collective_seconds="
+                    f"{trace_summary.get('exposed_collective_seconds')}")
+
     tokens_per_sec = mbs * seq / dt
     fwd_ft = perf.flops_for_config(cfg, seq)
     step_ft = perf.train_step_flops_per_token(fwd_ft)
@@ -500,7 +532,7 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
     mfu = perf.mfu(tokens_per_sec, step_ft, peak)
     log(f"bench: {dt * 1e3:.1f} ms/step, {tokens_per_sec:,.0f} tok/s/chip, "
         f"MFU {100 * mfu:.1f}% (peak {peak} TF)")
-    return {
+    out = {
         "ms_per_step": round(dt * 1e3, 2),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": mfu,
@@ -520,6 +552,23 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
         # coverage) for the measured executable
         "graph_audit": audit_summary,
     }
+    if trace_summary is not None:
+        # measured device-time facts (--trace): the achieved-overlap signal
+        # the autotune cost model calibrates against
+        out.update({
+            "achieved_overlap": json_float(
+                trace_summary.get("achieved_overlap"), 6),
+            "exposed_collective_seconds": json_float(
+                trace_summary.get("exposed_collective_seconds"), 6),
+            "collective_seconds": json_float(
+                trace_summary.get("collective_seconds"), 6),
+            "overlap_by_class": {
+                k: json_float(v.get("achieved_overlap"), 4)
+                for k, v in (trace_summary.get("overlap_by_class")
+                             or {}).items()
+            },
+        })
+    return out
 
 
 def plan_topk_measure(dev, base_cfg, policy, precision_block, seq: int,
@@ -643,6 +692,13 @@ def main() -> None:
                          "record predicted-vs-measured rank agreement "
                          "(Kendall tau) in the JSON line — every bench run "
                          "scores the cost model")
+    ap.add_argument("--trace", action="store_true",
+                    help="capture a short device-time trace window AFTER "
+                         "the timed loop (telemetry.trace) and emit the "
+                         "measured achieved_overlap / "
+                         "exposed_collective_seconds in the JSON line — "
+                         "the signal the autotune cost model's comms term "
+                         "calibrates against")
     ap.add_argument("--calibration", action="store_true",
                     help="low-fidelity connect-reliability run: append to the "
                          "measured log but do NOT refresh last_measured.json "
@@ -736,7 +792,8 @@ def main() -> None:
             try:
                 cfg = dataclasses.replace(cfg, num_layers=n_layers)
                 results[name] = run_bench(
-                    dev, cfg, policy, seq, args.mbs, steps, warmup)
+                    dev, cfg, policy, seq, args.mbs, steps, warmup,
+                    trace=args.trace)
                 results[name]["tied_embeddings"] = tied
                 used_cfgs[name] = cfg
                 errors.pop(name, None)  # a successful backoff clears the record
@@ -793,6 +850,9 @@ def main() -> None:
         "final_grad_norm": r.get("final_grad_norm"),
         # headline regime's static graph-audit verdict (analysis.graph_audit)
         "graph_audit": r.get("graph_audit"),
+        # measured device-time overlap (--trace; None when not captured)
+        "achieved_overlap": r.get("achieved_overlap"),
+        "exposed_collective_seconds": r.get("exposed_collective_seconds"),
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }

@@ -26,6 +26,8 @@ from neuronx_distributed_training_tpu.autotune.cost_model import (  # noqa: F401
     estimate_hbm_bytes,
     estimate_plan,
     kendall_tau,
+    overlap_from_trace_summary,
+    resolve_overlap,
 )
 from neuronx_distributed_training_tpu.autotune.planner import (  # noqa: F401
     PlanCandidate,

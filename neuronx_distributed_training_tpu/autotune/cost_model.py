@@ -114,9 +114,6 @@ _SCORE_BUFFERS = 3.0
 #: dropless-MoE routing workspace: f32 gate/up/activation rows plus
 #: gather/scatter hidden copies per routed token ([T*k, ffn] and [T*k, h])
 _MOE_ROUTE_BUFFERS = 6.0
-#: fraction of collective wire time hidden under compute (async collective
-#: fusion / per-layer gather-matmul pipelining); the remainder is exposed
-_COMMS_OVERLAP = 0.5
 #: pipeline stage-loop buffering per LOCAL layer per microbatch-token: the
 #: tick loop's stacked carries + per-tick vjp residuals.  Empirically
 #: nm-independent and IDENTICAL across schedules and remat policies on the
@@ -222,6 +219,97 @@ def estimate_hbm_bytes(facts: ModelFacts, plan: Plan,
 
 
 # --------------------------------------------------------------------------
+# compute/comms overlap model
+# --------------------------------------------------------------------------
+
+#: which measured collective classes (telemetry.trace_analysis /
+#: utils.debug.COLLECTIVE_KINDS) dominate each comms axis's wire time, in
+#: the order the calibration prefers them.  tp/dp under SP+ZeRO-1 are
+#: AG/RS-shaped (plain variants fall back to all-reduce); pp hops and cp
+#: ring passes lower to collective-permutes; ulysses-cp and ep dispatch are
+#: all-to-alls.
+_AXIS_KINDS: dict[str, tuple[str, ...]] = {
+    "tp": ("all-gather", "reduce-scatter", "all-reduce"),
+    "dp": ("reduce-scatter", "all-gather", "all-reduce"),
+    "pp": ("collective-permute",),
+    "cp": ("collective-permute", "all-to-all"),
+    "ep": ("all-to-all",),
+}
+
+
+def resolve_overlap(overlap: Any, topo: ChipTopology) -> dict[str, float]:
+    """Normalize an overlap input into ``{axis: hidden_fraction}`` over the
+    comms axes (+ ``"default"``).
+
+    ``None`` -> the topology table's per-generation prior for every axis; a
+    float -> that fraction everywhere; a mapping -> per-axis fractions with
+    ``"default"`` (else the topology prior) filling unnamed axes.  Values
+    clamp to [0, 0.99] — a measured 1.0 would price comms as literally free
+    and hide every comms regression from the ranking."""
+    base = float(topo.comms_overlap)
+    if overlap is None:
+        per_axis: dict[str, Any] = {}
+    elif isinstance(overlap, (int, float)):
+        base = float(overlap)
+        per_axis = {}
+    else:
+        per_axis = dict(overlap)
+        base = float(per_axis.pop("default", base))
+    clamp = lambda v: min(max(float(v), 0.0), 0.99)
+    out = {"default": clamp(base)}
+    for axis in _AXIS_KINDS:
+        out[axis] = clamp(per_axis.get(axis, base))
+    return out
+
+
+def overlap_from_trace_summary(summary: Any) -> dict[str, float]:
+    """Measured per-axis overlap calibration out of a ``trace_summary.json``
+    payload (the dict, its file path, or a run dir containing it).
+
+    Each comms axis takes the wire-time-weighted achieved overlap of its
+    collective classes (``_AXIS_KINDS``); axes whose classes were absent
+    from the trace fall back to the overall ``achieved_overlap``.  The
+    result feeds :func:`estimate_plan`'s ``overlap`` parameter — predicted
+    comms cost then uses OBSERVED hiding instead of the topology prior."""
+    from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
+        load_trace_summary,
+    )
+
+    from typing import Mapping as _Mapping
+
+    summary = load_trace_summary(summary)
+    by_class = dict(summary.get("overlap_by_class") or {})
+    for kind, c in by_class.items():
+        # malformed shapes must surface as ValueError (the planner turns
+        # that into a report error, not a CLI traceback)
+        if not isinstance(c, _Mapping):
+            raise ValueError(
+                f"malformed trace summary: overlap_by_class[{kind!r}] must "
+                f"be a mapping with wire_seconds/hidden_seconds, got "
+                f"{type(c).__name__}"
+            )
+    out: dict[str, float] = {}
+    overall = summary.get("achieved_overlap")
+    if overall is not None:
+        out["default"] = float(overall)
+    for axis, kinds in _AXIS_KINDS.items():
+        wire = hidden = 0.0
+        for kind in kinds:
+            c = by_class.get(kind)
+            if c and c.get("wire_seconds"):
+                wire += float(c["wire_seconds"])
+                hidden += float(c.get("hidden_seconds", 0.0))
+        if wire > 0:
+            out[axis] = hidden / wire
+    if not out:
+        raise ValueError(
+            "trace summary carries no collective overlap data (no "
+            "collectives in the traced window?) — nothing to calibrate from"
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
 # time model
 # --------------------------------------------------------------------------
 
@@ -272,10 +360,13 @@ class PlanEstimate:
 
 
 def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
-                  *, hbm_headroom: float = 0.9) -> PlanEstimate:
+                  *, hbm_headroom: float = 0.9,
+                  overlap: Any = None) -> PlanEstimate:
     """Score one plan.  ``fits`` is False when the HBM estimate exceeds
     ``hbm_headroom`` x the topology's capacity (the runtime and fragmentation
-    own the rest)."""
+    own the rest).  ``overlap`` — None (topology default), a fraction, or a
+    per-axis mapping (:func:`overlap_from_trace_summary`) — sets how much of
+    each axis's collective wire time is priced as hidden under compute."""
     from neuronx_distributed_training_tpu.utils.perf import (
         flops_breakdown_for_model,
     )
@@ -356,9 +447,14 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
     # XLA overlaps collectives with compute aggressively (async collective
     # fusion; per-layer SP gathers hide under the matmuls that consume
     # them), so only a fraction of the wire time is EXPOSED step time.
-    # A single factor — per-collective overlap windows are a documented
-    # blind spot of the analytic ranking (docs/autotuning.md).
-    comms = {k: v * (1.0 - _COMMS_OVERLAP) for k, v in comms.items()}
+    # The fraction is per axis: the topology table's prior by default, or
+    # the MEASURED per-collective-class overlap when a telemetry.trace
+    # calibration is supplied (overlap_from_trace_summary) — scheduled
+    # overlap windows themselves are still a documented blind spot of the
+    # analytic ranking (docs/autotuning.md).
+    hidden = resolve_overlap(overlap, topo)
+    comms = {k: v * (1.0 - hidden.get(k, hidden["default"]))
+             for k, v in comms.items()}
     comms_total = sum(comms.values())
 
     # ---- bubble ----

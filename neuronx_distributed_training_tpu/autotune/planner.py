@@ -34,6 +34,8 @@ from neuronx_distributed_training_tpu.autotune.cost_model import (
     PlanEstimate,
     estimate_hbm_bytes,
     estimate_plan,
+    overlap_from_trace_summary,
+    resolve_overlap,
 )
 from neuronx_distributed_training_tpu.autotune.space import (
     ModelFacts,
@@ -97,6 +99,10 @@ class PlanReport:
     n_fit: int                        # plans inside the HBM budget
     facts: Optional[ModelFacts] = None
     error: Optional[str] = None
+    #: per-axis compute/comms overlap the ranking priced with ("default" +
+    #: comms axes); "measured" marks a telemetry.trace calibration vs the
+    #: topology-table prior
+    overlap: Optional[dict] = None
 
     @property
     def winner(self) -> Optional[PlanCandidate]:
@@ -114,6 +120,9 @@ class PlanReport:
             "n_fit": self.n_fit,
             "candidates": [c.to_dict() for c in self.candidates],
         }
+        if self.overlap is not None:
+            d["overlap"] = {k: (round(v, 4) if isinstance(v, float) else v)
+                            for k, v in self.overlap.items()}
         w = self.winner
         d["winner"] = dataclasses.asdict(w.plan) if w else None
         if self.error:
@@ -150,6 +159,13 @@ class PlanReport:
             f"topology={self.topology}: {self.n_plans} legal plans, "
             f"{self.n_fit} inside the HBM budget"
         ]
+        if self.overlap is not None:
+            src = ("measured" if self.overlap.get("measured")
+                   else "topology default")
+            axes = ", ".join(
+                f"{k}={v:.2f}" for k, v in sorted(self.overlap.items())
+                if isinstance(v, float))
+            lines.append(f"comms overlap ({src}): {axes}")
         if self.error:
             lines.append(f"ERROR: {self.error}")
             return "\n".join(lines)
@@ -188,13 +204,17 @@ def rank_plans(
     *,
     hbm_headroom: float = 0.9,
     max_mbs: int = 8,
+    overlap: Any = None,
 ) -> tuple[list[PlanCandidate], int, int]:
     """Enumerate + score the lattice.  Returns (ranked candidates, lattice
     size, fitting count).  Plans over the HBM budget rank strictly below
     every fitting plan (they are kept so a too-small topology still yields a
-    ranked report instead of nothing)."""
+    ranked report instead of nothing).  ``overlap`` threads straight into
+    :func:`~.cost_model.estimate_plan` — a measured calibration reprices
+    every plan's comms term and can reorder the ranking."""
     plans = enumerate_plans(facts, chips, max_mbs=max_mbs)
-    scored = [(p, estimate_plan(facts, p, topo, hbm_headroom=hbm_headroom))
+    scored = [(p, estimate_plan(facts, p, topo, hbm_headroom=hbm_headroom,
+                                overlap=overlap))
               for p in plans]
     n_fit = sum(1 for _, e in scored if e.fits)
     scored.sort(key=lambda pe: (not pe[1].fits, pe[1].step_seconds)
@@ -298,11 +318,18 @@ def plan_config(
     hbm_headroom: float = 0.9,
     max_mbs: int = 8,
     max_devices: int = 8,
+    calibration: Any = None,
 ) -> PlanReport:
     """Plan a launch for ``source`` on ``chips`` devices — the one-call
     entry.  ``chips`` defaults to the config's ``trainer.devices``, else the
     smallest world its declared degrees need.  With ``audit=False`` the
-    report is analytic-only (the ``--check`` gate's fast path)."""
+    report is analytic-only (the ``--check`` gate's fast path).
+
+    ``calibration`` — a ``trace_summary.json`` path (or run dir, or its
+    loaded dict) from a ``telemetry.trace`` capture — replaces the topology
+    table's comms-overlap prior with the MEASURED per-collective-class
+    overlap, so predicted comms cost reflects what the scheduler actually
+    hid on this workload (``tools/plan.py --calibrate-from``)."""
     from neuronx_distributed_training_tpu.config.loader import load_config
 
     name = (Path(source).name if isinstance(source, (str, Path))
@@ -323,11 +350,25 @@ def plan_config(
                 * max(declared.ep, 1) if declared else 1)
     topo = resolve_topology(topology) if topology else resolve_topology(
         device=_first_device())
+    overlap = None
+    measured = False
+    if calibration is not None:
+        try:
+            overlap = overlap_from_trace_summary(calibration)
+            measured = True
+        except (OSError, ValueError) as e:
+            return PlanReport(config=name, chips=chips, topology=topo.name,
+                              candidates=[], n_plans=0, n_fit=0, facts=facts,
+                              error=f"overlap calibration failed: "
+                                    f"{type(e).__name__}: {e}")
+    overlap_used = dict(resolve_overlap(overlap, topo), measured=measured)
     ranked, n_plans, n_fit = rank_plans(
-        facts, chips, topo, hbm_headroom=hbm_headroom, max_mbs=max_mbs)
+        facts, chips, topo, hbm_headroom=hbm_headroom, max_mbs=max_mbs,
+        overlap=overlap)
     if not ranked:
         return PlanReport(config=name, chips=chips, topology=topo.name,
                           candidates=[], n_plans=0, n_fit=0, facts=facts,
+                          overlap=overlap_used,
                           error="no legal plan for this chip count "
                                 "(check divisibility of heads/layers/batch)")
     if audit:
@@ -338,7 +379,7 @@ def plan_config(
         candidates = ranked[:top_k]
     return PlanReport(config=name, chips=chips, topology=topo.name,
                       candidates=candidates, n_plans=n_plans, n_fit=n_fit,
-                      facts=facts)
+                      facts=facts, overlap=overlap_used)
 
 
 def _first_device():

@@ -44,6 +44,13 @@ class ChipTopology:
     #: matmul efficiency the compute roofline assumes (achievable MFU on
     #: large well-tiled matmuls, not the marketing peak)
     compute_efficiency: float = 0.55
+    #: default fraction of collective wire time the XLA scheduler hides
+    #: under concurrent compute on this generation (async collective fusion,
+    #: per-layer gather-matmul pipelining) — the cost model's prior when no
+    #: MEASURED calibration is supplied (``telemetry.trace`` writes the
+    #: measured figure to ``trace_summary.json``; ``tools/plan.py
+    #: --calibrate-from`` feeds it back in and overrides this)
+    comms_overlap: float = 0.5
 
     @property
     def peak_flops(self) -> float:
@@ -66,6 +73,7 @@ TOPOLOGIES: dict[str, ChipTopology] = {
         # directions of one axis -> ~90 GB/s effective per chip
         ici_bandwidth_bytes=90e9,
         ici_latency_seconds=1e-6,
+        comms_overlap=0.5,
     ),
     "v5p": ChipTopology(
         name="v5p",
@@ -74,6 +82,9 @@ TOPOLOGIES: dict[str, ChipTopology] = {
         # 3D torus, ~90 GB/s/dir/link, bidirectional ring
         ici_bandwidth_bytes=180e9,
         ici_latency_seconds=1e-6,
+        # 3D torus: more ring axes available to schedule around, and the
+        # latency-hiding scheduler has deeper HBM headroom for prefetch
+        comms_overlap=0.55,
     ),
     "v6e": ChipTopology(
         name="v6e",
@@ -81,6 +92,7 @@ TOPOLOGIES: dict[str, ChipTopology] = {
         hbm_bytes=32 * 1024**3,
         ici_bandwidth_bytes=180e9,
         ici_latency_seconds=1e-6,
+        comms_overlap=0.55,
     ),
     "v4": ChipTopology(
         name="v4",
@@ -89,6 +101,7 @@ TOPOLOGIES: dict[str, ChipTopology] = {
         # 3D torus, ~45 GB/s/dir/link, bidirectional ring
         ici_bandwidth_bytes=90e9,
         ici_latency_seconds=1e-6,
+        comms_overlap=0.45,
     ),
     # off-hardware planning/test fallback: ratios realistic, magnitudes not
     "cpu": ChipTopology(

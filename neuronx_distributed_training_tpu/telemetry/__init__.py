@@ -35,6 +35,15 @@ from neuronx_distributed_training_tpu.telemetry.spans import (
     NON_PRODUCTIVE_SPANS,
     SpanTimer,
 )
+from neuronx_distributed_training_tpu.telemetry.trace import (
+    TraceCapture,
+    TraceConfig,
+    trace_steps,
+)
+from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
+    analyze_trace_dir,
+    load_trace_summary,
+)
 
 __all__ = [
     "HEALTH_POLICIES",
@@ -46,7 +55,12 @@ __all__ = [
     "SpanTimer",
     "TELEMETRY_KNOBS",
     "TelemetryConfig",
+    "TraceCapture",
+    "TraceConfig",
+    "analyze_trace_dir",
     "compile_census",
     "grad_group_of",
+    "load_trace_summary",
     "memory_analysis_bytes",
+    "trace_steps",
 ]

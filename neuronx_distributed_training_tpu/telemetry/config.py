@@ -15,11 +15,16 @@ manager, and the config validator all agree on the schema:
         health:               # numerics flight recorder (telemetry.health)
           enabled: false
           policy: dump_and_continue
+        trace:                # windowed device-time capture (telemetry.trace)
+          enabled: false
+          start_step: 1
+          num_steps: 3
 
 Everything defaults ON except ``device_memory`` (``memory_stats()`` is a
-backend query some runtimes answer slowly) and ``health`` (its anomaly
+backend query some runtimes answer slowly), ``health`` (its anomaly
 counters live inside the optimizer state, so enabling it changes the
-checkpoint tree — an explicit opt-in) — the layer is designed to be
+checkpoint tree — an explicit opt-in), and ``trace`` (a profiler window
+has real capture overhead inside it) — the layer is designed to be
 cheap enough to leave on: span timing is ``time.perf_counter`` bookkeeping,
 MFU is arithmetic on the already-maintained throughput window, and the census
 runs once at first compile.  None of the knobs adds a host sync between
@@ -32,9 +37,11 @@ import dataclasses
 from typing import Any, Mapping
 
 from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
+from neuronx_distributed_training_tpu.telemetry.trace import TraceConfig
 
 #: boolean knob name -> default; the single source of truth for schema
-#: validation (the nested ``health`` block validates via HealthConfig)
+#: validation (the nested ``health``/``trace`` blocks validate via their
+#: own dataclasses)
 TELEMETRY_KNOBS: dict[str, bool] = {
     "spans": True,
     "mfu": True,
@@ -59,6 +66,7 @@ class TelemetryConfig:
     goodput: bool = True
     graph_audit: bool = False
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
+    trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
 
     @classmethod
     def from_config(cls, block: Any) -> "TelemetryConfig":
@@ -74,21 +82,22 @@ class TelemetryConfig:
         if isinstance(block, bool):
             # blanket bool switches the boolean knobs (True keeps each knob's
             # default, False forces all off); health (an opt-in that changes
-            # the opt-state tree) stays at its default: disabled
+            # the opt-state tree) and trace (an opt-in capture window) stay
+            # at their defaults: disabled
             return cls(**{k: block and v for k, v in TELEMETRY_KNOBS.items()})
         if not isinstance(block, Mapping):
             raise ValueError(
                 f"exp_manager.telemetry must be a mapping of "
-                f"{sorted(TELEMETRY_KNOBS) + ['health']} (or a single bool), "
-                f"got {type(block).__name__}"
+                f"{sorted(TELEMETRY_KNOBS) + ['health', 'trace']} (or a "
+                f"single bool), got {type(block).__name__}"
             )
-        unknown = set(block) - set(TELEMETRY_KNOBS) - {"health"}
+        unknown = set(block) - set(TELEMETRY_KNOBS) - {"health", "trace"}
         if unknown:
             from neuronx_distributed_training_tpu.config.loader import (
                 did_you_mean,
             )
 
-            options = sorted(TELEMETRY_KNOBS) + ["health"]
+            options = sorted(TELEMETRY_KNOBS) + ["health", "trace"]
             raise ValueError(
                 f"unknown exp_manager.telemetry keys {sorted(unknown)}; "
                 f"supported: {options}" + did_you_mean(unknown, options)
@@ -97,6 +106,9 @@ class TelemetryConfig:
         for k, v in block.items():
             if k == "health":
                 values[k] = HealthConfig.from_config(v)
+                continue
+            if k == "trace":
+                values[k] = TraceConfig.from_config(v)
                 continue
             if not isinstance(v, bool):
                 raise ValueError(

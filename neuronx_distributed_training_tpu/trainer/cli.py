@@ -94,6 +94,15 @@ def main() -> None:
                          "candidates, impose the winner on the config, and "
                          "record the plan in run_summary.json.  Optional "
                          "value overrides autotune.top_k")
+    ap.add_argument("--trace", nargs="?", const="", default=None,
+                    metavar="START[:NUM]",
+                    help="windowed device-time capture "
+                         "(exp_manager.telemetry.trace): trace NUM steps "
+                         "from START (default 1:3), analyze achieved "
+                         "compute/comms overlap, and write "
+                         "trace_summary.json next to run_summary.json — "
+                         "shorthand for the --set knobs "
+                         "(docs/observability.md 'Device-time profiling')")
     ap.add_argument("--compilation-cache", default=os.environ.get(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/nxdt_xla_cache"),
         help="persistent XLA compilation cache dir")
@@ -123,6 +132,17 @@ def main() -> None:
     overrides = parse_overrides(args.overrides)
     if os.environ.get("TRAIN_ITERS"):  # reference test hook
         overrides["trainer.max_steps"] = int(os.environ["TRAIN_ITERS"])
+    if args.trace is not None:
+        overrides["exp_manager.telemetry.trace.enabled"] = True
+        if args.trace:
+            start, _, num = args.trace.partition(":")
+            try:
+                overrides["exp_manager.telemetry.trace.start_step"] = int(start)
+                if num:
+                    overrides["exp_manager.telemetry.trace.num_steps"] = int(num)
+            except ValueError:
+                raise SystemExit(
+                    f"--trace wants START[:NUM] step numbers, got {args.trace!r}")
 
     if args.audit_only:
         from neuronx_distributed_training_tpu.analysis.graph_audit import (

@@ -90,6 +90,15 @@ class ExpManager:
         self.profile_start_step = profile_start_step
         self.profile_num_steps = profile_num_steps
         self._profiling = False
+        # windowed device-time capture (telemetry.trace): summary lands in
+        # trace_summary.json next to run_summary.json
+        self._trace: Optional[Any] = None
+        if self.telemetry.trace.enabled:
+            from neuronx_distributed_training_tpu.telemetry.trace import (
+                TraceCapture,
+            )
+
+            self._trace = TraceCapture(self.telemetry.trace, self.log_dir)
 
         self._tb = None
         if create_tensorboard_logger:
@@ -194,18 +203,57 @@ class ExpManager:
     # -- profiling (jax.profiler -> TensorBoard profile plugin; the TPU-native
     # replacement for neuron-top/neuron-monitor, SURVEY.md §5.1) --------------
 
+    _PROFILE_OWNER = "exp_manager.profile"
+
     def maybe_profile(self, step: int) -> None:
-        """Start/stop a ``jax.profiler`` trace around the configured window."""
+        """Start/stop a ``jax.profiler`` trace around the configured window.
+
+        Start/stop go through the telemetry.trace session guard: the jax
+        profiler session is process-global, and the unguarded window-end
+        stop here vs the teardown stop in :meth:`close` could double-stop
+        (raising out of teardown) — or stomp a live ``telemetry.trace``
+        capture window."""
         if not self.profile_start_step:
             return
-        import jax
+        from neuronx_distributed_training_tpu.telemetry.trace import (
+            start_session,
+            stop_session,
+        )
 
         if step == self.profile_start_step and not self._profiling:
-            jax.profiler.start_trace(str(self.log_dir / "profile"))
-            self._profiling = True
+            self._profiling = start_session(
+                str(self.log_dir / "profile"), self._PROFILE_OWNER)
         elif self._profiling and step >= self.profile_start_step + self.profile_num_steps:
-            jax.profiler.stop_trace()
             self._profiling = False
+            stop_session(self._PROFILE_OWNER)
+
+    def maybe_trace(self, step: int) -> None:
+        """Advance the ``telemetry.trace`` capture window (no-op when the
+        knob is off).  When the window closes, the analyzed summary is in
+        ``trace_summary.json`` and its headline numbers (achieved overlap,
+        exposed collective seconds) are merged into ``run_summary.json``."""
+        if self._trace is None:
+            return
+        summary = self._trace.maybe_update(step)
+        if summary is not None:
+            self._record_trace_summary(summary)
+
+    @property
+    def trace_active(self) -> bool:
+        """Is a telemetry.trace capture window currently open?  The trainer
+        keeps emitting ``StepTraceAnnotation``s while this is True even when
+        ``spans`` is off, so per-step attribution always has windows."""
+        return self._trace is not None and self._trace.active
+
+    def _record_trace_summary(self, summary: dict[str, Any]) -> None:
+        self.write_run_summary({"trace": {
+            "achieved_overlap": summary.get("achieved_overlap"),
+            "exposed_collective_seconds": summary.get(
+                "exposed_collective_seconds"),
+            "collective_seconds": summary.get("collective_seconds"),
+            "window": summary.get("window"),
+            "summary_path": str(self._trace.summary_path),
+        }})
 
     # -- per-step hooks -----------------------------------------------------
 
@@ -294,10 +342,18 @@ class ExpManager:
 
     def close(self) -> None:
         if self._profiling:
-            import jax
+            # guarded: a window that already closed (or was stopped
+            # out-of-band) makes this a logged no-op, not a teardown raise
+            from neuronx_distributed_training_tpu.telemetry.trace import (
+                stop_session,
+            )
 
-            jax.profiler.stop_trace()
             self._profiling = False
+            stop_session(self._PROFILE_OWNER)
+        if self._trace is not None:
+            summary = self._trace.close()
+            if summary is not None:
+                self._record_trace_summary(summary)
         if self._tb is not None:
             self._tb.flush()
             self._tb.close()

@@ -1168,6 +1168,10 @@ class Trainer:
                 last_fetch = self.step
                 while self.step < self.max_steps:
                     self.exp.maybe_profile(self.step)
+                    # device-time capture window (telemetry.trace): start/
+                    # stop rides the same per-step cadence; steps outside
+                    # the window are untouched (no syncs, no graph changes)
+                    self.exp.maybe_trace(self.step)
                     with spans.span("data_wait"):
                         batch = next(batches)
                     key = jax.random.fold_in(
@@ -1178,10 +1182,14 @@ class Trainer:
                     # host-side metadata check only (shapes/dtypes — never
                     # values): a mid-run signature change means a retrace
                     detector.check("train_step", batch)
+                    # the step annotation also bounds the trace capture's
+                    # per-step device-time attribution, so it stays on for
+                    # an open trace window even when spans are off
                     annot = (
                         jax.profiler.StepTraceAnnotation(
                             "train", step_num=self.step)
-                        if tel.spans else contextlib.nullcontext()
+                        if tel.spans or self.exp.trace_active
+                        else contextlib.nullcontext()
                     )
                     # "dispatch" is host enqueue time: under dispatch-ahead
                     # the device runs behind and this span stays tiny; device

@@ -80,10 +80,33 @@ def assert_tree_sharding(tree: Any, specs: Any, mesh: Mesh) -> None:
         )
 
 
-_COLLECTIVES = (
+#: the collective kinds the census (and everything downstream of it — the
+#: graph auditor's GA101/GA102 classes, the autotune cost model's per-axis
+#: byte volumes, the device-trace overlap analytics) classifies by
+COLLECTIVE_KINDS = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all",
 )
+_COLLECTIVES = COLLECTIVE_KINDS
+
+#: HLO op NAMES of collectives: plain and async ``-start`` forms count (the
+#: ``-start`` op carries the wire time); ``-done`` halves are the completion
+#: wait, deliberately NOT a collective so nothing double-counts — the same
+#: convention as the text census below
+_KIND_NAME_RE = re.compile(
+    r"^%?(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start)?(\.\d+)?$"
+)
+
+
+def collective_kind_of(op_name: str) -> str | None:
+    """Collective kind of one HLO op *name* (``all-reduce.3``,
+    ``all-gather-start.1`` -> their kind; ``-done`` halves, fusions, and
+    non-collectives -> ``None``).  The name-level twin of the text census:
+    trace analytics classify device-timeline ops with the same kind set the
+    compile census counts, so the two surfaces always line up."""
+    m = _KIND_NAME_RE.match(op_name)
+    return m.group(1) if m else None
 
 
 def collective_counts(jitted_fn, *args, **kwargs) -> dict[str, int]:

@@ -123,6 +123,32 @@ def anomalies_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def trace_section(trace: dict) -> str:
+    """Device-time trace summary (telemetry.trace -> trace_summary.json):
+    achieved overlap, exposed collective time, and the top-5 op table —
+    render the full breakdown with ``tools/trace_report.py``."""
+    if not trace:
+        return ""
+    lines = ["", "device-time trace (tools/trace_report.py renders the "
+                 "full breakdown)"]
+    ov = trace.get("achieved_overlap")
+    if ov is not None:
+        lines.append(f"  achieved_overlap      {100 * float(ov):.1f}% of "
+                     f"collective wire time hidden under compute")
+    for key in ("collective_seconds", "exposed_collective_seconds",
+                "total_device_seconds"):
+        if trace.get(key) is not None:
+            lines.append(f"  {key:<21} {_fmt(trace[key])}")
+    top = (trace.get("top_ops") or [])[:5]
+    if top:
+        lines.append("  top ops by device time:")
+        for o in top:
+            lines.append(
+                f"    {o.get('op', '?'):<20} {_fmt(o.get('total_seconds', 0))} s"
+                f"  ({100 * o.get('share', 0.0):.1f}%, {o.get('class', '?')})")
+    return "\n".join(lines)
+
+
 def census_section(summary: dict) -> str:
     lines: list[str] = []
     if "compile_seconds" in summary:
@@ -153,7 +179,7 @@ def census_section(summary: dict) -> str:
 
 
 def render(metrics_path: str | None, summary_path: str | None,
-           last_n: int = 0) -> str:
+           last_n: int = 0, trace_path: str | None = None) -> str:
     parts: list[str] = []
     if metrics_path and os.path.exists(metrics_path):
         records = load_metrics(metrics_path)
@@ -172,6 +198,12 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(goodput_section(summary))
         parts.append(anomalies_section(summary))
         parts.append(census_section(summary))
+    if trace_path and os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                parts.append(trace_section(json.load(f)))
+        except ValueError as e:
+            parts.append(f"unreadable {trace_path}: {e}")
     return "\n".join(p for p in parts if p)
 
 
@@ -192,10 +224,13 @@ def main(argv: list[str] | None = None) -> int:
         summary_path = os.path.join(os.path.dirname(path), "run_summary.json")
     else:
         metrics_path, summary_path = None, path
+    trace_path = (os.path.join(os.path.dirname(summary_path),
+                               "trace_summary.json")
+                  if summary_path else None)
     if not any(p and os.path.exists(p) for p in (metrics_path, summary_path)):
         print(f"metrics_report: nothing to read at {path}", file=sys.stderr)
         return 2
-    print(render(metrics_path, summary_path, args.last))
+    print(render(metrics_path, summary_path, args.last, trace_path))
     return 0
 
 

@@ -175,6 +175,12 @@ def main() -> None:
                     help="CI gate: declared parallelism must be in the "
                          "planner's top-3 meshes (or carry an "
                          "'# autotune-waiver:' comment)")
+    ap.add_argument("--calibrate-from", metavar="TRACE_SUMMARY",
+                    help="a trace_summary.json (or run dir holding one) "
+                         "from a telemetry.trace capture: price comms with "
+                         "the MEASURED per-collective-class overlap instead "
+                         "of the topology table's prior "
+                         "(docs/observability.md 'Device-time profiling')")
     ap.add_argument("--apply", metavar="OUT_YAML",
                     help="write a copy of the (single) config with the "
                          "winning knobs imposed")
@@ -235,6 +241,7 @@ def main() -> None:
                 top_k=args.top_k, audit=args.audit,
                 hbm_headroom=args.hbm_headroom, max_mbs=args.max_mbs,
                 max_devices=min(16, len(jax.devices())),
+                calibration=args.calibrate_from,
             )
             print(rep.format(top=args.top_k))
             print()

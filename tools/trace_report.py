@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Render a device-time trace summary as a terminal report.
+
+The ocular check on where device time actually went: achieved compute/comms
+overlap per collective class (hidden vs exposed wire time), the top-K
+device-time op table, and per-step attribution — everything the windowed
+``telemetry.trace`` capture wrote to ``trace_summary.json``.
+
+    python tools/trace_report.py nxdt_experiments/hf_llama3_8B/version_0
+    python tools/trace_report.py path/to/trace_summary.json
+    python tools/trace_report.py path/to/raw_trace_dir   # runs the parser
+    python tools/trace_report.py run_dir --json -        # last line = JSON
+
+Accepts a run dir (reads its ``trace_summary.json``), the summary file
+itself, or a RAW capture directory / ``*.trace.json(.gz)`` file — raw
+inputs go through ``telemetry.trace_analysis`` on the spot (that path
+needs the package importable; the summary-rendering path is stdlib-only).
+``--json`` writes the full summary through the shared ``tools/_jsonout.py``
+single-last-line contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+
+def _fmt_s(v: float) -> str:
+    """Seconds, scaled for readability."""
+    if v >= 1.0:
+        return f"{v:.3f} s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f} ms"
+    return f"{v * 1e6:.1f} us"
+
+
+def _table(rows: list[tuple], header: tuple) -> str:
+    widths = [max(len(str(r[i])) for r in [header, *rows])
+              for i in range(len(header))]
+
+    def fmt_row(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt_row(header), sep, *(fmt_row(r) for r in rows)])
+
+
+def load_summary(path: str, *, top_k: int = 15) -> dict:
+    """Summary dict from any accepted input form (see module docstring)."""
+    if os.path.isdir(path):
+        summary_file = os.path.join(path, "trace_summary.json")
+        if os.path.exists(summary_file):
+            with open(summary_file) as f:
+                return json.load(f)
+        # raw capture dir -> parse in place
+        return _analyze(path, top_k)
+    if path.endswith(".trace.json") or path.endswith(".trace.json.gz"):
+        return _analyze(path, top_k)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _analyze(path: str, top_k: int) -> dict:
+    try:
+        from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
+            analyze_trace_dir,
+        )
+    except ImportError as e:
+        raise SystemExit(
+            f"trace_report: raw-trace input needs the "
+            f"neuronx_distributed_training_tpu package importable ({e}); "
+            f"point at a trace_summary.json instead"
+        )
+    return analyze_trace_dir(path, top_k=top_k)
+
+
+def render(summary: dict, *, top: int = 10) -> str:
+    parts: list[str] = []
+    window = summary.get("window") or {}
+    head = "device-time trace"
+    if window:
+        head += (f" (steps {window.get('start_step')}.."
+                 f"{window.get('start_step', 0) + window.get('num_steps', 0) - 1})")
+    devices = summary.get("devices") or []
+    parts.append(
+        f"{head}: {summary.get('num_op_events', 0)} op events over "
+        f"{len(devices)} device lane{'s' if len(devices) != 1 else ''}")
+
+    total = float(summary.get("total_device_seconds") or 0.0)
+    comp = float(summary.get("compute_seconds") or 0.0)
+    coll = float(summary.get("collective_seconds") or 0.0)
+    exposed = float(summary.get("exposed_collective_seconds") or 0.0)
+    ov = summary.get("achieved_overlap")
+    lines = [
+        "",
+        f"  total_device_time         {_fmt_s(total)}",
+        f"  compute_time              {_fmt_s(comp)}",
+        f"  collective_wire_time      {_fmt_s(coll)}",
+        f"  exposed_collective_time   {_fmt_s(exposed)}"
+        + (f"  ({100 * exposed / total:.1f}% of device time)"
+           if total > 0 else ""),
+        f"  achieved_overlap          "
+        + (f"{100 * float(ov):.1f}% of collective wire time hidden "
+           f"under compute" if ov is not None else
+           "n/a (no collectives in the window)"),
+    ]
+    parts.append("\n".join(lines))
+
+    by_class = summary.get("overlap_by_class") or {}
+    if by_class:
+        rows = [
+            (kind, c.get("count", 0), _fmt_s(c.get("wire_seconds", 0.0)),
+             _fmt_s(c.get("hidden_seconds", 0.0)),
+             _fmt_s(c.get("exposed_seconds", 0.0)),
+             f"{100 * c.get('achieved_overlap', 0.0):.1f}%")
+            for kind, c in sorted(by_class.items())
+        ]
+        parts.append("\noverlap by collective class\n" + _table(
+            rows, ("class", "n", "wire", "hidden", "exposed", "overlap")))
+
+    top_ops = (summary.get("top_ops") or [])[:top]
+    if top_ops:
+        rows = [
+            (o["op"], o.get("class", "?"), o.get("count", 0),
+             _fmt_s(o.get("total_seconds", 0.0)),
+             f"{o.get('mean_us', 0.0):.1f}",
+             f"{100 * o.get('share', 0.0):.1f}%")
+            for o in top_ops
+        ]
+        parts.append(f"\ntop {len(rows)} ops by device time\n" + _table(
+            rows, ("op", "class", "n", "total", "mean_us", "share")))
+
+    steps = summary.get("steps") or {}
+    if steps:
+        rows = [
+            (f"step {s}", _fmt_s(d.get("device_seconds", 0.0)),
+             _fmt_s(d.get("compute_seconds", 0.0)),
+             _fmt_s(d.get("collective_seconds", 0.0)))
+            for s, d in sorted(steps.items(), key=lambda kv: int(kv[0]))
+        ]
+        parts.append("\nper-step device-time attribution\n" + _table(
+            rows, ("step", "device", "compute", "collective")))
+
+    parts.append(
+        "\ncalibrate the launch planner with this measurement:\n"
+        "  python tools/plan.py --config <cfg> --calibrate-from "
+        "<trace_summary.json>")
+    return "\n".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir / trace_summary.json / raw trace "
+                                 "dir / *.trace.json(.gz)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the op table (default 10)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="machine-readable summary ('-' for stdout; the "
+                         "payload is the guaranteed-last line)")
+    args = ap.parse_args(argv)
+
+    try:
+        summary = load_summary(args.path, top_k=max(args.top, 15))
+    except (OSError, ValueError) as e:
+        print(f"trace_report: nothing to read at {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    print(render(summary, top=args.top))
+    if args.json:
+        from _jsonout import write_json
+
+        write_json(summary, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
