@@ -13,11 +13,17 @@ GShard, arXiv:2004.13336):
   against the config's declared contracts: donation actually aliased, the
   collective census the parallelism config implies, no oversized replicated
   intermediates, no f32 matmuls under bf16 regimes;
+- ``graph_contract`` is the *relative* layer: a committed golden fingerprint
+  per example config (``contracts/`` — collective census by kind×axis-group
+  with per-collective provenance, donation map, matmul dtype census, memory
+  bytes) and a semantic differ that explains any regression in config-level
+  terms; growth must be declared in-file (``tools/graph_contract.py
+  --update-contracts --justify``);
 - ``jaxlint`` is an AST pass over the package flagging JAX pitfalls in jitted
   paths (hidden host syncs, tracer branching, wall-clock reads, PRNG key
-  reuse, donated-buffer reuse) with ``# jaxlint: disable=RULE`` suppressions
-  and a committed ratchet baseline;
-- ``tools/preflight_audit.py`` is the CLI gate over both.
+  reuse, donated-buffer reuse, explicit f32 upcasts) with
+  ``# jaxlint: disable=RULE`` suppressions and a committed ratchet baseline;
+- ``tools/preflight_audit.py`` is the CLI gate over all of it.
 
 Rule catalogue: ``docs/static_analysis.md``.
 """

@@ -211,13 +211,18 @@ def parse_alias_map(hlo_text: str) -> dict[int, int]:
     return out
 
 
-def audit_donation(report: AuditReport, ctx: AuditContext,
-                   hlo_texts: list[str]) -> None:
-    """GA001: every donated param/opt leaf must be aliased input→output."""
+def donation_map(ctx: AuditContext, hlo_texts: list[str]) -> dict[str, Any]:
+    """Donation coverage accounting — the ONE implementation both GA001
+    (``audit_donation``) and the graph-contract fingerprint (GC301,
+    ``analysis.graph_contract``) read, so the absolute rule and the ratchet
+    can never disagree about which leaves are donated or aliased.
+
+    ``{"expected", "aliased", "coverage", "missing": [leaf paths]}`` —
+    flatten order matches XLA entry-parameter order for the leading donated
+    arguments."""
     donate = ctx.donate
     if donate in (False, "none", ()):
-        report.stats["donation_coverage"] = 0.0
-        return
+        return {"expected": 0, "aliased": 0, "coverage": 0.0, "missing": []}
     trees = [("params", ctx.params_tree)]
     if donate in (True, "all"):
         trees.append(("opt_state", ctx.opt_tree))
@@ -227,19 +232,32 @@ def audit_donation(report: AuditReport, ctx: AuditContext,
     aliased: set[int] = set()
     for text in hlo_texts:
         aliased |= set(parse_alias_map(text).values())
-    missing = [i for i in range(len(paths)) if i not in aliased]
-    report.stats["donated_expected"] = len(paths)
-    report.stats["donated_aliased"] = len(paths) - len(missing)
-    report.stats["donation_coverage"] = (
-        round(1.0 - len(missing) / max(len(paths), 1), 4)
-    )
-    for i in missing:
+    missing = [paths[i] for i in range(len(paths)) if i not in aliased]
+    return {
+        "expected": len(paths),
+        "aliased": len(paths) - len(missing),
+        "coverage": round(1.0 - len(missing) / max(len(paths), 1), 4),
+        "missing": missing,
+    }
+
+
+def audit_donation(report: AuditReport, ctx: AuditContext,
+                   hlo_texts: list[str]) -> None:
+    """GA001: every donated param/opt leaf must be aliased input→output."""
+    dm = donation_map(ctx, hlo_texts)
+    if ctx.donate in (False, "none", ()):
+        report.stats["donation_coverage"] = 0.0
+        return
+    report.stats["donated_expected"] = dm["expected"]
+    report.stats["donated_aliased"] = dm["aliased"]
+    report.stats["donation_coverage"] = dm["coverage"]
+    for path in dm["missing"]:
         report.add(
             "GA001", "error",
-            f"donated leaf {paths[i]}: its buffer is not reused by any "
+            f"donated leaf {path}: its buffer is not reused by any "
             f"output in the compiled executable (donated-but-copied — the "
             f"bytes are resident twice)",
-            location=f"entry parameter {i}",
+            location=f"donated leaf {path}",
             hint="a dtype/layout change between the input leaf and its "
                  "updated output defeats aliasing; keep the update "
                  "dtype-preserving (check DtypePolicy casts and optimizer "
@@ -709,13 +727,19 @@ def audit_executable(ctx: AuditContext, compiled: Any, lowered: Any = None,
 
 
 def audit_step_program(asm: Any, *, replication_slack: float = 8.0,
-                       config_name: str = "") -> AuditReport:
+                       config_name: str = "",
+                       artifacts_out: Optional[dict] = None) -> AuditReport:
     """Lower + compile a :class:`StepProgram` abstractly and audit it.
 
     Spec lint (GA401) runs first: a spec naming an absent mesh axis (or
     double-using one) would die inside the partitioner with a message naming
     neither leaf nor axis — here it dies with both, and lowering is
-    skipped."""
+    skipped.
+
+    ``artifacts_out``, when given, receives ``{"ctx", "compiled",
+    "stablehlo"}`` on a successful lowering — callers that ALSO fingerprint
+    the artifact (the graph-contract ratchet riding a pre-flight sweep)
+    reuse the one lowering instead of paying a second."""
     from neuronx_distributed_training_tpu.parallel.sharding import spec_errors
 
     errors = spec_errors({"params": asm.pspecs, "opt_state": asm.ospecs},
@@ -732,6 +756,8 @@ def audit_step_program(asm: Any, *, replication_slack: float = 8.0,
         return report
     stablehlo, compiled = lower_step_program(asm)
     ctx = AuditContext.from_step_program(asm)
+    if artifacts_out is not None:
+        artifacts_out.update(ctx=ctx, compiled=compiled, stablehlo=stablehlo)
     return audit_artifacts(
         ctx, compiled, stablehlo, replication_slack=replication_slack,
         config_name=config_name,
@@ -836,6 +862,7 @@ def audit_config(
     max_devices: Optional[int] = None,
     replication_slack: float = 8.0,
     overrides: Optional[Mapping] = None,
+    artifacts_out: Optional[dict] = None,
 ) -> AuditReport:
     """Load a YAML config, (optionally) shrink it, AOT-lower its train step,
     and audit the compiled artifact.  The one-call entry the CLI and the
@@ -861,8 +888,14 @@ def audit_config(
         )
         return report
     devices = devices if devices is not None else jax.devices()
+    # shrunk audits run on a CANONICAL world (≤ 8 devices) END TO END: both
+    # the shrink itself (data_mult / global_batch_size) and the lowering
+    # pool below — the compiled artifact, and the graph-contract fingerprint
+    # snapshotted from it, must not depend on how many virtual devices this
+    # machine's pool happens to hold
+    avail = min(len(devices), 8) if shrink else len(devices)
     if max_devices is None:
-        max_devices = len(devices)
+        max_devices = avail
     try:
         if shrink:
             shr = shrink_overrides(cfg, max_devices=max_devices)
@@ -872,7 +905,7 @@ def audit_config(
                 source, (str, Path)) else load_config(dict(source), shr)
             report.stats["shrunk"] = True
         asm = assemble_step_program(
-            cfg, devices=list(devices)[: _world_of(cfg, len(devices))],
+            cfg, devices=list(devices)[: _world_of(cfg, avail)],
             build_data=False,
         )
     except Exception as e:  # noqa: BLE001 — assembly errors ARE the verdict
@@ -884,7 +917,8 @@ def audit_config(
         )
         return report
     sub = audit_step_program(
-        asm, replication_slack=replication_slack, config_name=name)
+        asm, replication_slack=replication_slack, config_name=name,
+        artifacts_out=artifacts_out)
     report.extend(sub)
     return report
 

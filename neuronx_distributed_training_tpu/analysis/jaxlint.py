@@ -16,6 +16,13 @@ each only in the scope where it is actually a pitfall:
 - **JL103 wall clock** (graph scope): ``time.time()``/``perf_counter()``/
   ``datetime.now()`` inside a step function traces to a constant — the
   timestamp of tracing, not of execution.
+- **JL106 f32 upcast in graph scope** (graph scope): an explicit
+  ``.astype(jnp.float32)`` / ``jnp.astype(x, jnp.float32)`` inside traced
+  code — the source-level twin of the graph audit's GA301: a bf16 value
+  widened to f32 mid-graph doubles its bytes and usually marks a matmul
+  that will run f32×f32 under a bf16 regime.  Deliberate widenings (the f32
+  router, softmax accumulators) are baselined in the ratchet rather than
+  suppressed, so NEW upcasts still fail.
 - **JL104 PRNG key reuse** (all scopes): the same key variable fed to two
   ``jax.random`` consumers without a ``split``/``fold_in`` reassignment in
   between — correlated randomness, the classic silent statistics bug.
@@ -184,6 +191,7 @@ class _FunctionLinter:
             self._lint_host_sync()
             self._lint_tracer_branch()
             self._lint_wall_clock()
+            self._lint_f32_upcast()
         self._lint_key_reuse()
 
     def _lint_host_sync(self) -> None:
@@ -248,6 +256,57 @@ class _FunctionLinter:
                     "constant (the time of TRACING, not execution)", n,
                     hint="measure on host around the dispatch, or thread a "
                          "step counter through the graph",
+                )
+
+    def _lint_f32_upcast(self) -> None:
+        """JL106: explicit widening to f32 inside traced code — the GA301
+        pitfall caught at source level, before lowering.  Flags
+        ``x.astype(<f32>)`` and ``jnp.astype(x, <f32>)`` where the target is
+        literally float32; dtype-preserving casts (``.astype(p.dtype)``,
+        ``policy.compute_dtype``) are not upcasts and pass."""
+
+        def is_f32(node: ast.AST) -> bool:
+            if isinstance(node, ast.Constant):
+                return node.value in ("float32", "f32")
+            name = _dotted(node)
+            if name.rsplit(".", 1)[-1] == "float32":
+                return True
+            # jnp.dtype("float32")
+            if isinstance(node, ast.Call) \
+                    and _dotted(node.func).rsplit(".", 1)[-1] == "dtype" \
+                    and node.args and isinstance(node.args[0], ast.Constant):
+                return node.args[0].value in ("float32", "f32")
+            return False
+
+        for n in self._walk_shallow(self.fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _dotted(n.func)
+            target = None
+            if name in ("jnp.astype", "jax.numpy.astype") \
+                    and len(n.args) >= 2:
+                # module form: jnp.astype(x, dtype)
+                target = n.args[1]
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "astype" \
+                    and not name.startswith(("jnp.", "jax.", "np.",
+                                             "numpy.")) and n.args:
+                # method form: x.astype(dtype)
+                target = n.args[0]
+            for kw in n.keywords or []:
+                if kw.arg == "dtype" and target is None \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "astype":
+                    target = kw.value
+            if target is not None and is_f32(target):
+                self.ctx.add(
+                    "JL106", "warn",
+                    "explicit f32 upcast inside graph scope (the GA301 "
+                    "pitfall at source level)", n,
+                    hint="widen through policy.compute_dtype / "
+                         "grad_accum_dtype instead of a literal float32, "
+                         "or baseline a deliberate widening (f32 router, "
+                         "softmax accumulator) via --update-baseline",
                 )
 
     def _lint_key_reuse(self) -> None:
@@ -510,11 +569,15 @@ def load_baseline(path: Path = BASELINE_PATH) -> list[str]:
 
 
 def write_baseline(report: AuditReport, path: Path = BASELINE_PATH) -> None:
+    """Sorted AND deduplicated: reruns over an unchanged tree are
+    byte-stable, and repeated identical snippets in one file (which share a
+    line-number-free fingerprint) collapse to the one entry the ratchet can
+    actually match."""
     path.write_text(json.dumps(
         {"comment": "jaxlint ratchet baseline — may only shrink; "
                     "regenerate with tools/preflight_audit.py "
                     "--update-baseline",
-         "findings": sorted(fingerprint(f) for f in report.findings)},
+         "findings": sorted({fingerprint(f) for f in report.findings})},
         indent=1,
     ) + "\n")
 
@@ -526,13 +589,18 @@ def apply_ratchet(report: AuditReport,
     Returns ``(fresh_report, stale_entries)``: ``fresh_report`` holds only
     NEW findings (escalated to error — the ratchet's fail condition), and
     ``stale_entries`` are baseline lines that matched nothing (the code got
-    cleaner; the baseline must shrink to match, so staleness fails too)."""
-    remaining = list(baseline)
+    cleaner; the baseline must shrink to match, so staleness fails too).
+
+    The baseline is a SET: fingerprints are line-number-free, so repeated
+    identical snippets in one file share one entry and all match it (the
+    file stores entries deduplicated — ``write_baseline``)."""
+    base = set(baseline)
+    matched: set[str] = set()
     fresh = AuditReport(config=report.config, stats=dict(report.stats))
     for f in report.findings:
         fp = fingerprint(f)
-        if fp in remaining:
-            remaining.remove(fp)
+        if fp in base:
+            matched.add(fp)
         else:
             fresh.findings.append(Finding(
                 rule=f.rule, severity="error",
@@ -540,6 +608,7 @@ def apply_ratchet(report: AuditReport,
                 hint=f.hint or "new finding (not in the committed baseline): "
                                "fix it or suppress with # jaxlint: disable=",
             ))
+    stale = sorted(base - matched)
     fresh.stats["baselined"] = len(report.findings) - len(fresh.findings)
-    fresh.stats["stale_baseline_entries"] = len(remaining)
-    return fresh, remaining
+    fresh.stats["stale_baseline_entries"] = len(stale)
+    return fresh, stale

@@ -222,19 +222,19 @@ def estimate_hbm_bytes(facts: ModelFacts, plan: Plan,
 # compute/comms overlap model
 # --------------------------------------------------------------------------
 
-#: which measured collective classes (telemetry.trace_analysis /
-#: utils.debug.COLLECTIVE_KINDS) dominate each comms axis's wire time, in
-#: the order the calibration prefers them.  tp/dp under SP+ZeRO-1 are
-#: AG/RS-shaped (plain variants fall back to all-reduce); pp hops and cp
-#: ring passes lower to collective-permutes; ulysses-cp and ep dispatch are
-#: all-to-alls.
-_AXIS_KINDS: dict[str, tuple[str, ...]] = {
-    "tp": ("all-gather", "reduce-scatter", "all-reduce"),
-    "dp": ("reduce-scatter", "all-gather", "all-reduce"),
-    "pp": ("collective-permute",),
-    "cp": ("collective-permute", "all-to-all"),
-    "ep": ("all-to-all",),
-}
+def _axis_kinds() -> dict[str, tuple[str, ...]]:
+    """Which measured collective classes dominate each comms axis's wire
+    time — the shared table in ``utils.debug.AXIS_COLLECTIVE_KINDS``, so the
+    cost model's per-axis byte classes, the trace analytics'
+    measured-overlap mapping, and the graph-contract provenance attribution
+    can never drift apart (one surface renaming a class would silently
+    decalibrate the rest).  Imported lazily: ``utils.debug`` pulls in jax,
+    and this module's plan math stays importable without it."""
+    from neuronx_distributed_training_tpu.utils.debug import (
+        AXIS_COLLECTIVE_KINDS,
+    )
+
+    return AXIS_COLLECTIVE_KINDS
 
 
 def resolve_overlap(overlap: Any, topo: ChipTopology) -> dict[str, float]:
@@ -257,7 +257,7 @@ def resolve_overlap(overlap: Any, topo: ChipTopology) -> dict[str, float]:
         base = float(per_axis.pop("default", base))
     clamp = lambda v: min(max(float(v), 0.0), 0.99)
     out = {"default": clamp(base)}
-    for axis in _AXIS_KINDS:
+    for axis in _axis_kinds():
         out[axis] = clamp(per_axis.get(axis, base))
     return out
 
@@ -267,7 +267,7 @@ def overlap_from_trace_summary(summary: Any) -> dict[str, float]:
     payload (the dict, its file path, or a run dir containing it).
 
     Each comms axis takes the wire-time-weighted achieved overlap of its
-    collective classes (``_AXIS_KINDS``); axes whose classes were absent
+    collective classes (``_axis_kinds``); axes whose classes were absent
     from the trace fall back to the overall ``achieved_overlap``.  The
     result feeds :func:`estimate_plan`'s ``overlap`` parameter — predicted
     comms cost then uses OBSERVED hiding instead of the topology prior."""
@@ -292,7 +292,7 @@ def overlap_from_trace_summary(summary: Any) -> dict[str, float]:
     overall = summary.get("achieved_overlap")
     if overall is not None:
         out["default"] = float(overall)
-    for axis, kinds in _AXIS_KINDS.items():
+    for axis, kinds in _axis_kinds().items():
         wire = hidden = 0.0
         for kind in kinds:
             c = by_class.get(kind)

@@ -13,6 +13,8 @@ result to ``run_summary.json`` next to ``metrics.jsonl``.
 from __future__ import annotations
 
 import logging
+import math
+import re
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
@@ -32,6 +34,105 @@ def hlo_texts_from_compiled(compiled: Any) -> list[str]:
     graph auditor (``analysis.graph_audit``) parse.  Kept here so "what the
     compiler actually produced" has a single accessor."""
     return [m.to_string() for m in compiled.runtime_executable().hlo_modules()]
+
+
+# -- structured collective parse (the graph-contract provenance input) -----
+
+#: collective op line: `%all-gather.5 = bf16[...] all-gather(...)` (async
+#: `-start` forms count once; `-done` halves are the completion wait)
+_COLLECTIVE_LINE_RE = re.compile(
+    r"(?P<op>%[\w.-]+)\s*=\s*[^=]*?"
+    r"\s(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<start>-start)?\("
+)
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})?\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_OPNAME_META_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _parse_iota_groups(dims: str, reshape: str,
+                       perm: Optional[str]) -> list[list[int]]:
+    """``replica_groups=[G,S]<=[r0,r1]T(p0,p1)``: iota over the reshape
+    dims, transposed by the permutation, re-flattened to G groups of S."""
+    out_dims = [int(d) for d in dims.split(",") if d]
+    r_dims = [int(d) for d in reshape.split(",") if d]
+    n = math.prod(r_dims) if r_dims else 0
+    ids = list(range(n))
+    if perm:
+        p = [int(x) for x in perm.split(",") if x]
+        # index math without numpy: value at transposed flat position
+        strides = [0] * len(r_dims)
+        acc = 1
+        for i in reversed(range(len(r_dims))):
+            strides[i] = acc
+            acc *= r_dims[i]
+        t_dims = [r_dims[i] for i in p]
+        t_strides = [strides[i] for i in p]
+        ids = []
+        idx = [0] * len(t_dims)
+        for _ in range(n):
+            ids.append(sum(i * s for i, s in zip(idx, t_strides)))
+            for d in reversed(range(len(t_dims))):
+                idx[d] += 1
+                if idx[d] < t_dims[d]:
+                    break
+                idx[d] = 0
+    size = out_dims[-1] if out_dims else n
+    return [ids[i: i + size] for i in range(0, n, max(size, 1))]
+
+
+def collective_ops_from_texts(texts: list[str]) -> list[dict[str, Any]]:
+    """Structured census: one entry per collective op in the compiled HLO —
+    ``{op, kind, groups, pairs, source_op}`` where ``groups`` is the parsed
+    replica-group partition (``None`` for "all devices" / unparseable),
+    ``pairs`` the source→target id pairs of a collective-permute, and
+    ``source_op`` the ``metadata op_name`` attribution XLA recorded (the
+    nearest named source op — what provenance findings cite).  The
+    kind-counting convention matches ``utils.debug``: ``-start`` counts,
+    ``-done`` does not."""
+    out: list[dict[str, Any]] = []
+    for text in texts:
+        for line in text.splitlines():
+            if "=" not in line:
+                continue
+            head, _, meta = line.partition("metadata=")
+            m = _COLLECTIVE_LINE_RE.search(head)
+            if not m:
+                continue
+            groups: Optional[list[list[int]]] = None
+            gm = _EXPLICIT_GROUPS_RE.search(head)
+            if gm and gm.group(1):
+                groups = [
+                    [int(x) for x in g.split(",") if x.strip()]
+                    for g in re.findall(r"\{([0-9, ]*)\}", gm.group(1))
+                ]
+            else:
+                im = _IOTA_GROUPS_RE.search(head)
+                if im:
+                    groups = _parse_iota_groups(im.group(1), im.group(2),
+                                                im.group(3))
+            pairs: Optional[list[tuple[int, int]]] = None
+            pm = _PAIRS_RE.search(head)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in re.findall(r"\{(\d+,\d+)\}", pm.group(1))]
+            nm = _OPNAME_META_RE.search(meta)
+            out.append({
+                "op": m.group("op").lstrip("%"),
+                "kind": m.group("kind"),
+                "groups": groups,
+                "pairs": pairs,
+                "source_op": nm.group(1) if nm else "",
+            })
+    return out
+
+
+def collective_ops_from_compiled(compiled: Any) -> list[dict[str, Any]]:
+    """Structured collective census of an already-compiled executable."""
+    return collective_ops_from_texts(hlo_texts_from_compiled(compiled))
 
 
 def memory_analysis_bytes(compiled: Any) -> Optional[dict[str, int]]:

@@ -1385,14 +1385,21 @@ class Trainer:
     def _graph_audit(self, compiled, lowered) -> None:
         """telemetry.graph_audit: run the static contract rules
         (analysis.graph_audit) against the very executable the loop is about
-        to train with, log every finding, and persist the verdict to
-        run_summary.json.  Pure host-side HLO inspection — no device work,
-        no extra compiles; failures degrade to a warning (the audit gates
-        pre-flight in tools/preflight_audit.py; in-loop it only observes)."""
+        to train with, attribute every collective to its declared source
+        (analysis.graph_contract provenance — an unattributed collective is
+        a GSPMD-inserted reshard and flips the verdict), log every finding,
+        and persist the verdict to run_summary.json.  Pure host-side HLO
+        inspection — no device work, no extra compiles; failures degrade to
+        a warning (the audit gates pre-flight in tools/preflight_audit.py
+        and tools/graph_contract.py; in-loop it only observes)."""
         try:
             from neuronx_distributed_training_tpu.analysis.graph_audit import (
                 AuditContext,
                 audit_executable,
+            )
+            from neuronx_distributed_training_tpu.analysis.graph_contract import (
+                attribution_report,
+                fingerprint_artifacts,
             )
             from neuronx_distributed_training_tpu.config.loader import (
                 batch_schedule,
@@ -1408,7 +1415,34 @@ class Trainer:
             )
             rep = audit_executable(ctx, compiled, lowered,
                                    log=logger.warning)
-            self.exp.write_run_summary({"graph_audit": rep.to_dict()})
+            summary: dict = {}
+            try:
+                stablehlo = ""
+                if lowered is not None:
+                    try:
+                        stablehlo = lowered.as_text()
+                    except Exception:  # noqa: BLE001 — dtype census degrades
+                        pass
+                fp = fingerprint_artifacts(ctx, compiled, stablehlo)
+                prov = attribution_report(fp)
+                for f in prov.findings:
+                    logger.warning(f.format())
+                rep.extend(prov)
+                summary["contract"] = {
+                    "collectives": {
+                        k: {"count": v["count"], "source": v["source"]}
+                        for k, v in fp["collectives"].items()},
+                    "collectives_total":
+                        prov.stats["collectives_total"],
+                    "collectives_unattributed":
+                        prov.stats["collectives_unattributed"],
+                    "matmul_dtypes": (fp.get("matmul_dtypes") or {}).get(
+                        "counts"),
+                }
+            except Exception as e:  # noqa: BLE001 — provenance is additive
+                logger.warning("collective provenance failed: %s", e)
+            summary = {**rep.to_dict(), **summary}
+            self.exp.write_run_summary({"graph_audit": summary})
         except Exception as e:  # noqa: BLE001 — observability must not kill
             logger.warning("graph audit failed: %s", e)
 

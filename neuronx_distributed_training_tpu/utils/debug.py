@@ -89,6 +89,23 @@ COLLECTIVE_KINDS = (
 )
 _COLLECTIVES = COLLECTIVE_KINDS
 
+#: which collective kinds each comms AXIS (tp/dp/pp/cp/ep) legitimately
+#: produces, in the order calibration prefers them.  The single source all
+#: three static/measured surfaces share: the autotune cost model prices each
+#: axis's bytes on these kinds, the trace analytics map measured
+#: per-kind overlap back onto axes, and the graph-contract provenance
+#: attributes compiled collectives to declared sources.  tp/dp under
+#: SP+ZeRO-1 are AG/RS-shaped (plain variants fall back to all-reduce); pp
+#: hops and cp ring passes lower to collective-permutes; ulysses-cp and ep
+#: dispatch are all-to-alls.
+AXIS_COLLECTIVE_KINDS: dict[str, tuple[str, ...]] = {
+    "tp": ("all-gather", "reduce-scatter", "all-reduce"),
+    "dp": ("reduce-scatter", "all-gather", "all-reduce"),
+    "pp": ("collective-permute",),
+    "cp": ("collective-permute", "all-to-all"),
+    "ep": ("all-to-all",),
+}
+
 #: HLO op NAMES of collectives: plain and async ``-start`` forms count (the
 #: ``-start`` op carries the wire time); ``-done`` halves are the completion
 #: wait, deliberately NOT a collective so nothing double-counts — the same

@@ -151,6 +151,58 @@ def build(key):
 """)
         assert not [f for f in rep.findings if f.rule == "JL104"], rep.format()
 
+    def test_jl106_astype_f32(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch):
+    x = batch.astype(jnp.float32)
+    y = jnp.astype(params, jnp.float32)
+    return (x + y).sum()
+
+g = jax.jit(loss_fn)
+""")
+        assert sum(f.rule == "JL106" for f in rep.findings) == 2, rep.format()
+
+    def test_jl106_string_and_dtype_forms(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch):
+    a = batch.astype("float32")
+    b = batch.astype(jnp.dtype("float32"))
+    return (a + b).sum()
+
+g = jax.jit(loss_fn)
+""")
+        assert sum(f.rule == "JL106" for f in rep.findings) == 2, rep.format()
+
+    def test_jl106_policy_cast_ok(self, tmp_path):
+        """Dtype-preserving / policy-mediated casts are not upcasts."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch, policy):
+    a = batch.astype(policy.compute_dtype)
+    b = batch.astype(params.dtype)
+    c = batch.astype(jnp.bfloat16)
+    return (a + b + c).sum()
+
+g = jax.jit(loss_fn)
+""")
+        assert not [f for f in rep.findings if f.rule == "JL106"], rep.format()
+
+    def test_jl106_host_scope_skipped(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def report(metrics):
+    return metrics.astype(jnp.float32)
+""")
+        assert not [f for f in rep.findings if f.rule == "JL106"], rep.format()
+
+    def test_jl106_suppression(self, tmp_path):
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, batch):
+    x = batch.astype(jnp.float32)  # jaxlint: disable=JL106
+    return x.sum()
+
+g = jax.jit(loss_fn)
+""")
+        assert not [f for f in rep.findings if f.rule == "JL106"], rep.format()
+
     def test_jl105_donated_reuse(self, tmp_path):
         rep = lint_snippet(tmp_path, GRAPH_HEADER + """
 def host_loop(params, opt, batch):
@@ -269,6 +321,46 @@ g = jax.grad(loss_fn)
         assert load_baseline(path) == sorted(
             fingerprint(f) for f in rep.findings)
         assert json.loads(path.read_text())["findings"]
+
+    def test_write_baseline_sorted_and_deduplicated(self, tmp_path):
+        """Repeated identical snippets share one line-number-free
+        fingerprint: the baseline stores it ONCE, sorted, and a rewrite over
+        an unchanged tree is byte-identical."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    print(x.sum().item())
+    print(x.sum().item())
+    return x
+
+g = jax.grad(loss_fn)
+""")
+        assert len(rep.findings) == 2
+        fps = [fingerprint(f) for f in rep.findings]
+        assert fps[0] == fps[1]  # identical snippet -> identical fingerprint
+        path = tmp_path / "baseline.json"
+        write_baseline(rep, path)
+        entries = load_baseline(path)
+        assert entries == sorted(set(fps)) and len(entries) == 1
+        first = path.read_bytes()
+        write_baseline(rep, path)
+        assert path.read_bytes() == first  # rerun is byte-stable
+
+    def test_deduplicated_entry_matches_every_duplicate(self, tmp_path):
+        """Set semantics: both findings of a duplicated snippet match the
+        single baseline entry — no fresh finding, no stale entry."""
+        rep = lint_snippet(tmp_path, GRAPH_HEADER + """
+def loss_fn(params, x):
+    print(x.sum().item())
+    print(x.sum().item())
+    return x
+
+g = jax.grad(loss_fn)
+""")
+        path = tmp_path / "baseline.json"
+        write_baseline(rep, path)
+        fresh, stale = apply_ratchet(rep, load_baseline(path))
+        assert not fresh.findings and not stale
+        assert fresh.stats["baselined"] == 2
 
 
 def test_package_lints_clean_against_committed_baseline():

@@ -72,6 +72,64 @@ def _required_world(config_paths: list[str], shrink: bool) -> int:
     return world
 
 
+def _audit_worker(args: tuple) -> dict:
+    """Graph-audit one config in a worker process (--jobs).  The parent
+    exported XLA_FLAGS / JAX_PLATFORMS before the pool spawned, so each
+    worker initializes its own correctly-sized CPU world; results carry the
+    pre-rendered text so the parent can merge output deterministically."""
+    path, shrink, slack, platform, contracts = args
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from neuronx_distributed_training_tpu.analysis.graph_audit import (
+        audit_config,
+    )
+
+    artifacts: dict = {}
+    rep = audit_config(path, shrink=shrink, replication_slack=slack,
+                       artifacts_out=artifacts)
+    out = {"path": path, "report": rep.to_dict(), "text": rep.format(),
+           "failed_warn": rep.failed("warn"),
+           "failed_error": rep.failed("error")}
+    if contracts and artifacts:
+        # the graph-contract ratchet rides the SAME lowering the absolute
+        # rules just audited — no second compile per config.  A failure
+        # here (corrupt snapshot, fingerprint bug) must become THIS
+        # config's finding, not kill the whole sweep.
+        try:
+            from neuronx_distributed_training_tpu.analysis import (
+                graph_contract as gc,
+            )
+
+            fp = gc.fingerprint_artifacts(
+                artifacts["ctx"], artifacts["compiled"],
+                artifacts["stablehlo"], config_name=os.path.basename(path))
+            fp["shrunk"] = bool(shrink)
+            crep = gc.check_contract(path, fp)
+            out["contract"] = crep.to_dict()
+            out["contract_text"] = (
+                f"contract [{os.path.basename(path)}]: "
+                f"{crep.worst() or 'clean'}"
+                + ("\n" + crep.format() if crep.findings else ""))
+            out["failed_warn"] |= crep.failed("warn")
+            out["failed_error"] |= crep.failed("error")
+        except Exception as e:  # noqa: BLE001 — a worker must return, not die
+            out["contract"] = {"verdict": "error",
+                               "error": f"{type(e).__name__}: {e}"}
+            out["contract_text"] = (
+                f"contract [{os.path.basename(path)}]: ERROR "
+                f"({type(e).__name__}: {e})")
+            out["failed_warn"] = out["failed_error"] = True
+    elif contracts:
+        out["contract"] = {"verdict": "error",
+                           "skipped": "no artifacts (config failed earlier)"}
+        out["contract_text"] = f"contract [{os.path.basename(path)}]: " \
+                               f"skipped (audit failed before lowering)"
+        out["failed_error"] = True
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -82,8 +140,18 @@ def main() -> None:
     ap.add_argument("--lint", action="store_true",
                     help="run the jaxlint source pass with the ratchet "
                          "baseline")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also check each config's compiled fingerprint "
+                         "against its committed graph contract "
+                         "(analysis/contracts/ — reuses the audit's "
+                         "lowering; tools/graph_contract.py is the "
+                         "standalone ratchet CLI)")
     ap.add_argument("--fail-on", choices=["warn", "error"], default="error",
                     help="severity that fails the run (default: error)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="graph-audit N configs in parallel processes (the "
+                         "sweep is embarrassingly parallel); output order "
+                         "stays deterministic (default 1: serial)")
     ap.add_argument("--no-shrink", dest="shrink", action="store_false",
                     help="audit configs at true size (needs a device world "
                          "as large as the config's parallel degrees)")
@@ -113,7 +181,8 @@ def main() -> None:
         ap.error("--update-baseline only makes sense with --lint (the "
                  "baseline is regenerated from the lint findings)")
 
-    # Size the virtual device world BEFORE jax initializes its backend.
+    # Size the virtual device world BEFORE jax initializes its backend
+    # (parent AND any --jobs worker: the env crosses the spawn).
     if configs and args.platform == "cpu":
         world = max(_required_world(configs, args.shrink), 8)
         flags = os.environ.get("XLA_FLAGS", "")
@@ -121,6 +190,35 @@ def main() -> None:
             os.environ["XLA_FLAGS"] = (
                 f"{flags} --xla_force_host_platform_device_count={world}"
             ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    failed = False
+    out: dict = {"reports": []}
+
+    work = [(p, args.shrink, args.replication_slack, args.platform,
+             args.contracts) for p in configs]
+    if args.jobs > 1 and len(work) > 1:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(
+                max_workers=min(args.jobs, len(work)),
+                mp_context=mp.get_context("spawn")) as pool:
+            results = list(pool.map(_audit_worker, work))
+    else:
+        results = [_audit_worker(w) for w in work]
+
+    for res in results:  # input order: deterministic merged output
+        print(res["text"])
+        if "contract_text" in res:
+            print(res["contract_text"])
+        print()
+        report = res["report"]
+        if "contract" in res:
+            report = {**report, "contract": res["contract"]}
+        out["reports"].append(report)
+        failed |= res["failed_warn" if args.fail_on == "warn"
+                      else "failed_error"]
 
     import jax
 
@@ -128,22 +226,6 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from neuronx_distributed_training_tpu.analysis import jaxlint
-    from neuronx_distributed_training_tpu.analysis.graph_audit import (
-        audit_config,
-    )
-
-    failed = False
-    out: dict = {"reports": []}
-
-    for path in configs:
-        rep = audit_config(
-            path, shrink=args.shrink,
-            replication_slack=args.replication_slack,
-        )
-        print(rep.format())
-        print()
-        out["reports"].append(rep.to_dict())
-        failed |= rep.failed(args.fail_on)
 
     if args.lint:
         full = jaxlint.lint_package()
