@@ -621,10 +621,16 @@ def plan_topk_measure(dev, base_cfg, policy, precision_block, seq: int,
             activations_checkpoint_granularity=(
                 None if plan.remat == "none" else plan.remat),
         )
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            predicted_bubble_fraction,
+        )
+
         row = {"plan": plan.describe(),
                "predicted_ms": round(cand.estimate.step_seconds * 1e3, 2),
                "predicted_hbm_gb": round(cand.estimate.hbm_bytes / 1024**3,
                                          3),
+               "bubble_fraction_predicted": round(predicted_bubble_fraction(
+                   plan.schedule, plan.pp, plan.num_microbatches, plan.vp), 6),
                "measured_ms": None}
         try:
             # measure the SAME unit the estimate prices: all nm microbatches
@@ -853,6 +859,12 @@ def main() -> None:
         # measured device-time overlap (--trace; None when not captured)
         "achieved_overlap": r.get("achieved_overlap"),
         "exposed_collective_seconds": r.get("exposed_collective_seconds"),
+        # pipeline-schedule telemetry (run_summary.json key names): the
+        # single-chip bench runs unpipelined, so the headline prediction is
+        # 0.0 — the field exists so the bench trajectory and trainer
+        # summaries share a schema (plan-topk rows carry per-plan values)
+        "pipeline_schedule": "none",
+        "bubble_fraction_predicted": 0.0,
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }

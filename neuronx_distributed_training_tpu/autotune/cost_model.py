@@ -12,9 +12,14 @@ Three independent terms per plan, each a closed-form function of the
   gradient reduction + ZeRO-1 regather, pp stage hops, cp ring/all-to-all
   passes, ep token exchange) priced on the topology's ring model
   ``bytes x (N-1)/(N x bw) + hops x latency``.
-- **bubble**: ``(pp-1)/nm`` of the in-pipeline work — the classic pipeline
-  fill/drain fraction (1F1B and the wavefront share it; they differ in
-  MEMORY, which the HBM model accounts separately).
+- **bubble**: the schedule's fill/drain fraction of the in-pipeline work
+  (``parallel.pipeline.bubble_multiplier`` — the one table telemetry also
+  reports): ``(pp-1)/nm`` for plain 1F1B and the vp=1 wavefront,
+  ``(pp-1)/(nm*vp)`` under a virtual pipeline (wavefront or
+  ``1f1b-interleaved`` — the interleave IS the bubble win), and
+  ``(pp-1)/(3*nm)`` for ``1f1b-zb`` (the deferred-wgrad tail fills the
+  cooldown; the warmup third remains).  Schedules additionally differ in
+  MEMORY, which the HBM model accounts separately.
 
 The HBM estimate mirrors the runtime's actual residency: params in
 ``param_dtype`` (sharded tp x pp, experts additionally ep), gradients in
@@ -182,16 +187,31 @@ def hbm_breakdown(facts: ModelFacts, plan: Plan,
             * (ffn + h) / plan.tp * 4
 
     act = layers_local * c_tok * tokens_mb
+    pipe_rings = 0.0
     if plan.pp > 1:
-        # asymptotic in-flight residency: 1F1B drains a microbatch's
-        # residuals after at most pp ticks, the autodiff wavefront holds
-        # every microbatch's forward until its backward arrives.  At tiny
+        # asymptotic in-flight residency: the manual-vjp family drains a
+        # microbatch's residuals after at most pp ticks, the autodiff
+        # wavefront holds every microbatch's forward until its backward
+        # arrives (all nm*vp work items under a virtual pipeline).  At tiny
         # depths/counts the stage loop's own fixed buffering dominates (the
         # calibrated floor — compiled temps there are nm- and
         # schedule-independent); max() keeps the floor AND the asymptote.
-        in_flight = (min(plan.pp, plan.num_microbatches)
-                     if plan.schedule == "1f1b" else plan.num_microbatches)
+        if plan.schedule in ("1f1b", "1f1b-zb", "1f1b-interleaved"):
+            in_flight = min(plan.pp, plan.num_microbatches)
+        else:
+            in_flight = plan.num_microbatches * max(plan.vp, 1)
         act *= max(_PP_STAGE_BUFFERS, float(in_flight))
+        # stage-input-sized rings the NEW manual-vjp variants add on top of
+        # plain 1f1b's 2*pp slots (which the _PP_STAGE_BUFFERS calibration
+        # already absorbs): the interleave's [vp*nm] chunk-input store +
+        # two nm-slot circular hand-off stores, the zb split's pp-slot
+        # deferred-dy ring
+        input_bytes = tokens_mb * (h / sp_div) * abytes
+        if plan.schedule == "1f1b-interleaved":
+            extra_slots = (plan.vp + 2) * plan.num_microbatches - 2 * plan.pp
+            pipe_rings = max(extra_slots, 0) * input_bytes
+        elif plan.schedule == "1f1b-zb":
+            pipe_rings = plan.pp * input_bytes
 
     logits = _HEAD_BUFFERS * tokens_mb * facts.vocab / plan.tp * 4
     batch = (facts.global_batch_size / plan.dp) * facts.seq * 4 * 2
@@ -204,6 +224,8 @@ def hbm_breakdown(facts: ModelFacts, plan: Plan,
         "activations": act,
         "logits": logits,
     }
+    if pipe_rings:
+        out["pipeline_rings"] = pipe_rings
     if facts.num_experts and plan.ep > 1:
         # dropless MoE computes against the ep-GATHERED expert weights
         # (ops/moe.py weight-gather EP); the gathered copy is a transient
@@ -384,6 +406,11 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
         step_flops_tok += fwd          # one full extra forward in bwd
     elif plan.remat == "selective":
         step_flops_tok += core
+    if plan.schedule == "1f1b-zb":
+        # the deferred wgrad pass re-linearizes the stage against the saved
+        # input: one extra stage forward (everything but the head) per
+        # microbatch — the remat trade zb makes to empty the cooldown bubble
+        step_flops_tok += fwd - bd.get("head", 0.0)
     total_flops = facts.global_batch_size * facts.seq * step_flops_tok
     compute = total_flops / (chips * topo.peak_flops
                              * topo.compute_efficiency)
@@ -458,10 +485,19 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
     comms_total = sum(comms.values())
 
     # ---- bubble ----
+    # per-schedule fill/drain multiplier (parallel.pipeline.bubble_multiplier
+    # — one table shared with run_summary/bench telemetry): (pp-1)/nm for
+    # plain 1f1b / vp=1 wavefront, /(nm*vp) under a virtual pipeline,
+    # /(3*nm) for the zero-bubble split's residual warmup third
     bubble = 0.0
     if plan.pp > 1 and plan.num_microbatches > 0:
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            bubble_multiplier,
+        )
+
         inner = compute + comms_total - comms.get("dp", 0.0)
-        bubble = (plan.pp - 1) / plan.num_microbatches * inner
+        bubble = bubble_multiplier(
+            plan.schedule, plan.pp, plan.num_microbatches, plan.vp) * inner
 
     mem = hbm_breakdown(facts, plan, policy)
     fits = mem["total"] <= hbm_headroom * topo.hbm_bytes

@@ -22,8 +22,15 @@ Divisibility catalog (the static pruning):
   and under pp respects the blockwise kv-tile smoothness rule.
 - ``ep`` divides both the expert count and dp (EP carves DP, mesh.py).
 - ``dp = chips / (tp*pp*cp)`` exactly; ``gbs % (mbs * dp) == 0``.
-- schedule: ``1f1b`` only where ``supports_1f1b`` says so; ``wavefront``
-  always legal under pp.
+- schedule: the manual-vjp family (``1f1b``, its zero-bubble split
+  ``1f1b-zb``, and the circular interleave ``1f1b-interleaved`` with
+  ``vp > 1``) only where ``supports_1f1b`` says so; ``wavefront`` always
+  legal under pp.  Interleaved plans additionally need
+  ``num_layers % (pp*vp) == 0`` and ``nm >= pp`` (the runtime's
+  circular-store hazard rule).  ``wavefront`` with ``vp > 1`` is priced
+  when DECLARED by a config but not enumerated: at equal (pp, nm, vp) the
+  interleave dominates it on both bubble and memory, so the lattice emits
+  only the dominant point.
 """
 
 from __future__ import annotations
@@ -33,6 +40,12 @@ from typing import Any, Iterator, Mapping, Optional
 
 #: remat lattice dimension, cheapest-memory-last
 REMAT_POLICIES = ("none", "selective", "full")
+
+#: virtual-pipeline chunk counts the interleaved schedule explores — small
+#: on purpose: the bubble win is (pp-1)/(nm*vp), already 4x-diminished at
+#: vp=4, while per-chunk layer slices thin out (and chunk-input storage
+#: grows) linearly
+_VP_CANDIDATES = (2, 4)
 
 
 def divisors(n: int) -> list[int]:
@@ -48,10 +61,12 @@ class Plan:
     cp: int = 1
     ep: int = 1
     dp: int = 1
+    vp: int = 1                       # virtual pipeline (interleave) chunks
     micro_batch_size: int = 1
     num_microbatches: int = 1
     remat: str = "selective"          # none | selective | full
-    schedule: str = "none"            # none (pp==1) | wavefront | 1f1b
+    # none (pp==1) | wavefront | 1f1b | 1f1b-interleaved | 1f1b-zb
+    schedule: str = "none"
 
     @property
     def world(self) -> int:
@@ -59,8 +74,9 @@ class Plan:
 
     def key(self) -> tuple:
         """Canonical sort key — the deterministic enumeration order."""
-        return (self.tp, self.pp, self.cp, self.ep, self.micro_batch_size,
-                REMAT_POLICIES.index(self.remat), self.schedule)
+        return (self.tp, self.pp, self.cp, self.ep, self.vp,
+                self.micro_batch_size, REMAT_POLICIES.index(self.remat),
+                self.schedule)
 
     @property
     def mesh(self) -> tuple[int, int, int, int, int]:
@@ -75,7 +91,8 @@ class Plan:
             "distributed_strategy.pipeline_model_parallel_size": self.pp,
             "distributed_strategy.context_parallel_size": self.cp,
             "distributed_strategy.expert_model_parallel_size": self.ep,
-            "distributed_strategy.virtual_pipeline_model_parallel_size": 1,
+            "distributed_strategy.virtual_pipeline_model_parallel_size":
+                self.vp,
             # SP rides TP (the loader rejects sequence_parallel at tp=1)
             "distributed_strategy.sequence_parallel": (
                 facts.sequence_parallel and self.tp > 1),
@@ -91,6 +108,8 @@ class Plan:
         s = (f"dp={self.dp} tp={self.tp} pp={self.pp} cp={self.cp} "
              f"ep={self.ep} mbs={self.micro_batch_size} "
              f"nm={self.num_microbatches} remat={self.remat}")
+        if self.vp > 1:
+            s += f" vp={self.vp}"
         if self.pp > 1:
             s += f" sched={self.schedule}"
         return s
@@ -233,6 +252,7 @@ class ModelFacts:
             pp=int(ds.get("pipeline_model_parallel_size", 1) or 1),
             cp=int(ds.get("context_parallel_size", 1) or 1),
             ep=int(ds.get("expert_model_parallel_size", 1) or 1),
+            vp=int(ds.get("virtual_pipeline_model_parallel_size", 1) or 1),
             dp=0,
             micro_batch_size=int(data.get("micro_batch_size", 1) or 1),
             num_microbatches=0,
@@ -271,7 +291,7 @@ class ModelFacts:
         """The ``supports_1f1b`` context dict for a candidate plan."""
         return {
             "pipeline_model_parallel_size": plan.pp,
-            "virtual_pipeline_model_parallel_size": 1,
+            "virtual_pipeline_model_parallel_size": plan.vp,
             "context_parallel_size": plan.cp,
             "alignment": (self.alignment
                           if self.alignment in ("dpo", "orpo", "kto")
@@ -390,15 +410,29 @@ def enumerate_plans(
                     for mbs in _mbs_candidates(facts, dp, max_mbs=max_mbs,
                                                pp=pp):
                         nm = facts.global_batch_size // (mbs * dp)
-                        scheds: tuple[str, ...]
+                        # (schedule, vp) candidates: the manual-vjp family
+                        # where the gate admits it, plus the always-legal
+                        # wavefront.  1f1b-zb shares 1f1b's shape constraints
+                        # (vp == 1); 1f1b-interleaved carries its own vp
+                        # lattice dimension (layer-divisible, nm >= pp).
+                        scheds: list[tuple[str, int]]
                         if pp == 1:
-                            scheds = ("none",)
+                            scheds = [("none", 1)]
                         else:
                             base = Plan(tp=tp, pp=pp, cp=cp, ep=ep, dp=dp)
                             ok, _ = supports_1f1b(
                                 facts.model_cfg, facts._parallel_cfg(base))
-                            scheds = ("1f1b", "wavefront") if ok else (
-                                "wavefront",)
+                            scheds = [("wavefront", 1)]
+                            if ok:
+                                scheds += [("1f1b", 1), ("1f1b-zb", 1)]
+                                layer_unit = (facts.moe_groups
+                                              if facts.moe_frequency > 1
+                                              else facts.num_layers)
+                                for vpc in _VP_CANDIDATES:
+                                    if (nm >= pp
+                                            and layer_unit % (pp * vpc) == 0):
+                                        scheds.append(
+                                            ("1f1b-interleaved", vpc))
                         # the pipeline stage loop does not fold the remat
                         # policy into its tick structure (compiled temps are
                         # identical across policies under pp — cost_model),
@@ -411,9 +445,10 @@ def enumerate_plans(
                         else:
                             remats = remat_policies
                         for remat in remats:
-                            for sched in scheds:
+                            for sched, vpc in scheds:
                                 plans.append(Plan(
                                     tp=tp, pp=pp, cp=cp, ep=ep, dp=dp,
+                                    vp=vpc,
                                     micro_batch_size=mbs, num_microbatches=nm,
                                     remat=remat, schedule=sched,
                                 ))
@@ -429,7 +464,7 @@ def iter_unique_structures(plans: list[Plan]) -> Iterator[tuple[tuple, Plan]]:
     seen = set()
     for p in plans:
         key = (min(p.tp, 2), min(p.pp, 2), min(p.cp, 2), min(p.ep, 2),
-               p.remat, p.schedule)
+               min(p.vp, 2), p.remat, p.schedule)
         if key in seen:
             continue
         seen.add(key)

@@ -196,20 +196,21 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError(f"global_batch_size {gbs} not divisible by micro_batch_size {mbs}")
 
     # ---- pipeline schedule ------------------------------------------------
-    # distributed_strategy.pipeline.schedule: auto | 1f1b | wavefront.  The
-    # full model-aware gate is parallel.pipeline.supports_1f1b (resolved at
-    # trainer build); the config-shape constraints die here with curated
-    # messages.
+    # distributed_strategy.pipeline.schedule: auto | 1f1b | 1f1b-interleaved |
+    # 1f1b-zb | wavefront.  The full model-aware gate is
+    # parallel.pipeline.supports_1f1b (resolved at trainer build); the
+    # config-shape constraints die here with curated messages.
     pipe_raw = ds.get("pipeline", {}) or {}
     if not isinstance(pipe_raw, Mapping):
         raise ValueError(
             f"distributed_strategy.pipeline must be a mapping of knobs "
-            f"(schedule: auto/1f1b/wavefront), got "
+            f"(schedule: auto/1f1b/1f1b-interleaved/1f1b-zb/wavefront), got "
             f"{type(pipe_raw).__name__}: {pipe_raw!r}"
         )
     pipe_knobs = dict(pipe_raw)
     if pipe_knobs:
         from neuronx_distributed_training_tpu.parallel.pipeline import (
+            MANUAL_VJP_SCHEDULES,
             PIPELINE_SCHEDULES,
             blocked_1f1b_reason,
         )
@@ -227,7 +228,7 @@ def validate_config(cfg: ConfigDict) -> None:
                 f"pipeline.schedule must be one of "
                 f"{'/'.join(PIPELINE_SCHEDULES)}, got {sched_knob!r}"
             )
-        if sched_knob == "1f1b":
+        if sched_knob in MANUAL_VJP_SCHEDULES:
             # same catalog the trainer-build gate uses (supports_1f1b); the
             # model-FAMILY constraints need the built model config and fire
             # at resolve_schedule instead
@@ -247,9 +248,9 @@ def validate_config(cfg: ConfigDict) -> None:
                 "context_parallel_size": cp,
                 "alignment": alignment,
                 "lora": bool(dict(model.get("lora", {}) or {})),
-            })
+            }, sched_knob)
             if blocked is not None:
-                raise ValueError(f"pipeline.schedule: 1f1b: {blocked}")
+                raise ValueError(f"pipeline.schedule: {sched_knob}: {blocked}")
 
     # ---- MoE --------------------------------------------------------------
     moe = model.get("moe", {}) or {}

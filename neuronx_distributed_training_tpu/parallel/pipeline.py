@@ -440,16 +440,27 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
 # d(loss)/d(loss_sum) = 1/denom_total (denom is a function of labels only) and
 # d(loss)/d(stage aux) = aux_scale.
 #
-# Scope: vp == 1, plain matmul head (tied embed or lm_head.w), token-level CE
-# (pretrain/SFT).  vp > 1, preference alignment, and exotic heads keep the
-# autodiff wavefront — ``supports_1f1b`` is the gate.
+# Scope: plain matmul head (tied embed or lm_head.w), token-level CE
+# (pretrain/SFT).  Three manual-vjp variants share the tick loop:
+# ``1f1b`` (vp == 1), ``1f1b-interleaved`` (vp > 1: the circular interleave
+# above, backward threaded through the same chunk ring), and ``1f1b-zb``
+# (vp == 1, ZB-H1-style: the backward tick splits into a dgrad pass whose
+# activation cotangent feeds the upstream stage immediately and a wgrad pass
+# deferred ``rank`` ticks into this rank's cooldown bubble).  Preference
+# alignment and exotic heads keep the autodiff wavefront —
+# ``supports_1f1b`` is the gate.
 
 
-PIPELINE_SCHEDULES = ("auto", "1f1b", "wavefront")
+PIPELINE_SCHEDULES = ("auto", "1f1b", "1f1b-interleaved", "1f1b-zb",
+                      "wavefront")
+#: the manual-vjp family (everything but the autodiff wavefront)
+MANUAL_VJP_SCHEDULES = ("1f1b", "1f1b-interleaved", "1f1b-zb")
 
 
-def blocked_1f1b_reason(parallel_cfg: dict) -> Optional[str]:
-    """Config-SHAPE constraints on the 1F1B schedule (no model object needed).
+def blocked_1f1b_reason(parallel_cfg: dict,
+                        schedule: str = "1f1b") -> Optional[str]:
+    """Config-SHAPE constraints on a manual-vjp schedule (no model object
+    needed).
 
     The single source of truth shared by ``supports_1f1b`` (trainer build)
     and ``config.loader.validate_config`` (load time) — one wording, one
@@ -461,54 +472,67 @@ def blocked_1f1b_reason(parallel_cfg: dict) -> Optional[str]:
     vp = int(parallel_cfg.get("virtual_pipeline_model_parallel_size", 1) or 1)
     cp = int(parallel_cfg.get("context_parallel_size", 1) or 1)
     alignment = parallel_cfg.get("alignment")
+    if schedule not in MANUAL_VJP_SCHEDULES:
+        raise ValueError(
+            f"blocked_1f1b_reason: not a manual-vjp schedule: {schedule!r}"
+        )
     if pp <= 1:
-        return "1f1b requires pipeline_model_parallel_size > 1"
-    if vp > 1:
+        return f"{schedule} requires pipeline_model_parallel_size > 1"
+    if vp > 1 and schedule != "1f1b-interleaved":
         return (
-            "the interleaved virtual pipeline "
-            "(virtual_pipeline_model_parallel_size > 1) runs only under the "
-            "autodiff wavefront schedule"
+            f"the virtual pipeline (virtual_pipeline_model_parallel_size > 1) "
+            f"runs under the circular interleaved manual-vjp schedule — set "
+            f"pipeline.schedule: 1f1b-interleaved (or auto) — not {schedule}"
+        )
+    if vp <= 1 and schedule == "1f1b-interleaved":
+        return (
+            "1f1b-interleaved needs virtual_pipeline_model_parallel_size > 1 "
+            "(with vp == 1 there is nothing to interleave; use 1f1b)"
         )
     if cp > 1:
         return (
-            "context parallelism under pp is proven for the autodiff "
-            "wavefront only (blockwise attention vjp inside the manual 1f1b "
-            "tick loop is unvalidated); use schedule: wavefront for pp x cp"
+            f"context parallelism under pp is proven for the autodiff "
+            f"wavefront only (blockwise attention vjp inside the manual "
+            f"{schedule} tick loop is unvalidated); use schedule: wavefront "
+            f"for pp x cp"
         )
     if alignment in ("dpo", "orpo", "kto"):
         return (
             f"preference alignment ({alignment}) pipelines via the "
-            f"concatenated-forward wavefront; 1f1b implements token-level CE "
-            f"only"
+            f"concatenated-forward wavefront; the manual-vjp schedules "
+            f"implement token-level CE only"
         )
     if parallel_cfg.get("lora"):
         return (
-            "LoRA adapters are not wired for the manual-vjp 1f1b head "
-            "(adapter grads on lm_head would be silently dropped)"
+            f"LoRA adapters are not wired for the manual-vjp {schedule} head "
+            f"(adapter grads on lm_head would be silently dropped)"
         )
     return None
 
 
-def supports_1f1b(model_cfg: Any, parallel_cfg: dict) -> tuple[bool, str]:
-    """Can the manual-vjp 1F1B schedule run this model/parallelism combo?
+def supports_1f1b(model_cfg: Any, parallel_cfg: dict,
+                  schedule: str = "1f1b") -> tuple[bool, str]:
+    """Can the manual-vjp ``schedule`` run this model/parallelism combo?
 
     Returns ``(ok, reason)``; ``reason`` explains the first blocking
     constraint when ``ok`` is False (and is the message ``resolve_schedule``
-    raises when the config FORCES ``1f1b``).
+    raises when the config FORCES a manual-vjp schedule).
 
     ``parallel_cfg`` mirrors the ``distributed_strategy`` block plus trainer
     context: ``pipeline_model_parallel_size``,
     ``virtual_pipeline_model_parallel_size``, ``context_parallel_size``,
     ``alignment`` (None/"sft" or a preference strategy), ``lora`` (bool).
-    The model side requires the plain-matmul-head token-CE structure the
-    in-loop vocab-sharded head implements: llama/mistral qualifies today.
-    Mixtral's head/aux wiring exists but its dropless-MoE stage vjp is gated
-    out (backend-dependent numerics — see the branch below), and
-    megatron-GPT (learned positions, dropout threading,
-    post_ln/normformer/gpt_j head variants) keeps the autodiff wavefront
-    until its head is wired.
+    ``schedule`` picks the variant: ``1f1b`` (vp == 1), ``1f1b-interleaved``
+    (the circular interleave, vp > 1), or ``1f1b-zb`` (the zero-bubble
+    dgrad/wgrad split, vp == 1).  The model side requires the
+    plain-matmul-head token-CE structure the in-loop vocab-sharded head
+    implements: llama/mistral qualifies today.  Mixtral's head/aux wiring
+    exists but its dropless-MoE stage vjp is gated out (backend-dependent
+    numerics — see the branch below), and megatron-GPT (learned positions,
+    dropout threading, post_ln/normformer/gpt_j head variants) keeps the
+    autodiff wavefront until its head is wired.
     """
-    blocked = blocked_1f1b_reason(parallel_cfg)
+    blocked = blocked_1f1b_reason(parallel_cfg, schedule)
     if blocked is not None:
         return False, blocked
     if getattr(model_cfg, "attention_impl", "") == "zigzag_ring":
@@ -516,7 +540,7 @@ def supports_1f1b(model_cfg: Any, parallel_cfg: dict) -> tuple[bool, str]:
     from neuronx_distributed_training_tpu.models import llama as _llama
 
     if isinstance(model_cfg, _llama.LlamaConfig):
-        return True, "llama/mistral: plain matmul head + token CE"
+        return True, f"llama/mistral: plain matmul head + token CE ({schedule})"
     from neuronx_distributed_training_tpu.models import mixtral as _mixtral
 
     if isinstance(model_cfg, _mixtral.MixtralConfig):
@@ -533,17 +557,24 @@ def supports_1f1b(model_cfg: Any, parallel_cfg: dict) -> tuple[bool, str]:
         )
     return False, (
         f"{type(model_cfg).__name__}: head not wired for the manual-vjp "
-        f"1f1b schedule (supported families: llama/mistral)"
+        f"{schedule} schedule (supported families: llama/mistral)"
     )
 
 
 def resolve_schedule(schedule: str, model_cfg: Any, parallel_cfg: dict) -> str:
-    """``pipeline.schedule`` knob -> concrete schedule ("1f1b"/"wavefront").
+    """``pipeline.schedule`` knob -> concrete schedule name.
 
-    ``auto`` picks 1f1b whenever ``supports_1f1b`` allows (the memory-bounded
-    production path: O(pp) in-flight activations instead of the wavefront's
-    O(nm + pp) autodiff residuals); forcing ``1f1b`` on an unsupported combo
-    raises with the gate's reason instead of failing deep inside shard_map.
+    ``auto`` picks the memory-bounded manual-vjp family whenever
+    ``supports_1f1b`` allows: ``1f1b-interleaved`` when the config carries a
+    virtual pipeline (vp > 1 — O(nm*vp) chunk inputs instead of the
+    wavefront's ~2x autodiff residuals, and the (pp-1)/(nm*vp) bubble), else
+    plain ``1f1b`` (O(pp) in-flight activations).  ``1f1b-zb`` is never
+    auto-selected: its deferred-wgrad pass re-linearizes the stage (one
+    extra forward per microbatch under remat), a trade the autotune cost
+    model prices per plan — force it via the knob or ``tools/plan.py
+    --apply`` when the bubble dominates (small nm/pp ratios).  Forcing any
+    manual-vjp schedule on an unsupported combo raises with the gate's
+    reason instead of failing deep inside shard_map.
     """
     schedule = str(schedule or "auto").lower()
     if schedule not in PIPELINE_SCHEDULES:
@@ -553,12 +584,55 @@ def resolve_schedule(schedule: str, model_cfg: Any, parallel_cfg: dict) -> str:
         )
     if schedule == "wavefront":
         return "wavefront"
-    ok, reason = supports_1f1b(model_cfg, parallel_cfg)
+    vp = int(parallel_cfg.get(
+        "virtual_pipeline_model_parallel_size", 1) or 1)
+    if schedule == "auto":
+        preferred = "1f1b-interleaved" if vp > 1 else "1f1b"
+        ok, _ = supports_1f1b(model_cfg, parallel_cfg, preferred)
+        return preferred if ok else "wavefront"
+    ok, reason = supports_1f1b(model_cfg, parallel_cfg, schedule)
+    if not ok:
+        raise ValueError(
+            f"pipeline.schedule: {schedule} is unsupported here: {reason}")
+    return schedule
+
+
+def bubble_multiplier(schedule: Optional[str], pp: int, nm: int,
+                      vp: int = 1) -> float:
+    """Pipeline-bubble work multiplier: fill/drain time as a fraction of the
+    schedule's useful in-pipeline work (what ``autotune.cost_model`` charges
+    as ``bubble_seconds = multiplier * inner``).
+
+    - ``wavefront`` / ``1f1b``: the classic ``(pp-1)/nm`` — with a virtual
+      pipeline the circular interleave cycles microbatches through the ranks
+      ``vp`` times, per-rank utilization ``nm*vp/(nm*vp + pp - 1)``
+      (``pipeline_loss`` docstring), so the multiplier divides by ``nm*vp``.
+    - ``1f1b-interleaved``: same ``(pp-1)/(nm*vp)`` — the interleave is the
+      bubble win; the manual vjp changes memory, not fill/drain.
+    - ``1f1b-zb``: ``(pp-1)/(3*nm)`` — ZB-H1 asymptotics: with the backward
+      split F:dgrad:wgrad ≈ 1:1:1, only the F+dgrad chain needs the
+      fill/drain serialization and the deferred wgrad tail fills the
+      cooldown, leaving the one-third warmup residual it cannot cover.
+    """
+    if pp <= 1 or nm <= 0:
+        return 0.0
+    vp = max(int(vp or 1), 1)
+    if schedule == "1f1b-zb":
+        return (pp - 1) / (3.0 * nm)
     if schedule == "1f1b":
-        if not ok:
-            raise ValueError(f"pipeline.schedule: 1f1b is unsupported here: {reason}")
-        return "1f1b"
-    return "1f1b" if ok else "wavefront"
+        return (pp - 1) / float(nm)
+    # wavefront + 1f1b-interleaved share the circular-interleave utilization
+    return (pp - 1) / float(nm * vp)
+
+
+def predicted_bubble_fraction(schedule: Optional[str], pp: int, nm: int,
+                              vp: int = 1) -> float:
+    """Predicted idle fraction of TOTAL pipelined step time,
+    ``b / (1 + b)`` for ``b = bubble_multiplier(...)`` — the telemetry
+    number (``run_summary.json`` / bench JSON ``bubble_fraction_predicted``);
+    0.0 when pp == 1."""
+    b = bubble_multiplier(schedule, pp, nm, vp)
+    return b / (1.0 + b)
 
 
 def _tree_index(tree, i):
@@ -585,7 +659,8 @@ def ce_denominator(microbatches: dict, *, shift_labels: bool,
 
 def pipeline_loss_and_grad(
     params: Any,
-    layer_params: Any,  # [num_layers, ...] with dim0 sharded over "pipe"
+    layer_params: Any,  # vp==1: [num_layers, ...] dim0 over "pipe";
+                        # vp>1: interleaved [vp, pp, Lc, ...] dim1 over "pipe"
     microbatches: dict[str, jax.Array],  # leaves [num_micro, mb, ...]
     *,
     embed_fn: EmbedFn,
@@ -595,17 +670,32 @@ def pipeline_loss_and_grad(
     head_weight: jax.Array,    # [V, H] — logits = h @ W.T; pipe-sharded on V
     mesh=None,
     num_microbatches: Optional[int] = None,
+    virtual_pipeline_size: int = 1,
+    zero_bubble: bool = False,
     stage_aux: bool = False,
     aux_scale: float = 0.0,
     shift_labels: bool = True,
     grad_dtype=jnp.float32,
     ignore_index: int = -100,
 ):
-    """1F1B pipeline step: returns ``(loss, grads)`` where ``grads`` has
-    exactly the keys ``{"layers", "params_from_embed", "head_params",
-    "head_weight"}`` (a tested invariant — tests/test_pipeline_1f1b.py):
+    """Manual-vjp pipeline step: returns ``(loss, grads)`` where ``grads``
+    has exactly the keys ``{"layers", "params_from_embed", "head_params",
+    "head_weight"}`` (a tested invariant — tests/test_pipeline_1f1b.py).
 
-    - ``layers``: [L, ...] tree, pipe-sharded like ``layer_params``;
+    ``virtual_pipeline_size > 1`` runs the circular interleaved 1F1B
+    (``1f1b-interleaved``): layers arrive in the ``to_interleaved``
+    ``[vp, pp, Lc, ...]`` layout, microbatches cycle through the ranks
+    ``vp`` times in the forward (the wavefront's circular schedule) and the
+    backward threads the chunk ring in reverse; like the wavefront it needs
+    ``num_microbatches >= pp`` (circular-store write-before-read).
+    ``zero_bubble`` runs the ZB-H1-style split (``1f1b-zb``, vp == 1 only):
+    the backward tick computes only the activation cotangent (dgrad) so the
+    upstream stage unblocks immediately, and the weight-gradient pass for
+    microbatch ``m`` is deferred ``rank`` ticks — exactly this rank's
+    cooldown-bubble budget — re-linearizing the stage against the saved
+    input (the remat trade: one extra stage forward per microbatch).
+
+    - ``layers``: tree shaped/sharded like ``layer_params``;
     - ``params_from_embed``: a PARAMS-shaped tree — the parked cotangent of
       the permuted embed feed has already been pulled through ``jax.vjp`` of
       the embed computation internally, so its ``embed`` entries hold the
@@ -624,8 +714,21 @@ def pipeline_loss_and_grad(
     mesh = mesh or shd.active_mesh()
     pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
     nm = num_microbatches or jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    vp = int(virtual_pipeline_size or 1)
     if pp <= 1:
         raise ValueError("pipeline_loss_and_grad requires pp > 1")
+    if zero_bubble and vp > 1:
+        raise ValueError(
+            "zero_bubble (1f1b-zb) is vp == 1 only; the interleaved chunk "
+            "ring has no per-rank cooldown window to defer wgrads into"
+        )
+    if vp > 1 and nm < pp:
+        # chunk c+1 reads the circular store at the tick chunk c's last-rank
+        # output is parked only when nm >= pp (same hazard as pipeline_loss)
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches >= pp "
+            f"(got nm={nm}, pp={pp}, vp={vp})"
+        )
 
     from jax.sharding import PartitionSpec as P
 
@@ -651,11 +754,12 @@ def pipeline_loss_and_grad(
     body = functools.partial(
         _onef1b_body,
         stage_fn=stage_fn, head_hidden_fn=head_hidden_fn, pp=pp, nm=nm,
+        vp=vp, zero_bubble=zero_bubble,
         slots=slots, stage_aux=stage_aux, aux_scale=float(aux_scale),
         shift_labels=shift_labels, grad_dtype=grad_dtype,
         ignore_index=ignore_index,
     )
-    layer_spec = P(PIPE_AXIS)
+    layer_spec = P(None, PIPE_AXIS) if vp > 1 else P(PIPE_AXIS)
     vocab_spec = P(PIPE_AXIS, *([None] * (head_weight.ndim - 1)))
     fn = shd.shard_map(
         body,
@@ -680,20 +784,40 @@ def pipeline_loss_and_grad(
 
 
 def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
-                 stage_fn, head_hidden_fn, pp, nm, slots, stage_aux, aux_scale,
-                 shift_labels, grad_dtype, ignore_index):
-    """Per-pipe-rank 1F1B tick loop (inside shard_map, manual over "pipe").
+                 stage_fn, head_hidden_fn, pp, nm, vp, zero_bubble, slots,
+                 stage_aux, aux_scale, shift_labels, grad_dtype, ignore_index):
+    """Per-pipe-rank manual-vjp tick loop (inside shard_map, manual "pipe").
 
-    Tick algebra (rank ``r``, tick ``t``):
-      forward of microbatch ``m_F = t - r``           (valid in [0, nm))
-      head (all ranks, vocab-sliced) of ``m_H = t - (pp-1)``
-      backward of ``m_B = t - (2*pp - 1) + r``        (valid in [0, nm))
-    ``T = nm + 2*pp - 1`` ticks total.  The head's dy for ``m`` lands in the
-    ``dy_next`` carry at tick ``m + pp - 1`` and the last rank consumes it one
-    tick later — exactly when its B(m) is scheduled.  Every collective
-    (forward ring hop, reverse ring hop, head psums, embed feed and embed-
-    cotangent routing switches) executes unconditionally or under tick-only
-    gates, so all devices always reach the same rendezvous.
+    Tick algebra (rank ``r``, tick ``t``, work index ``w = c*nm + m`` over
+    chunk ``c`` and microbatch ``m``; ``D = (vp-1)*nm + pp``):
+      forward of work ``w_F = t - r``                  (valid in [0, nm*vp))
+      head (all ranks, vocab-sliced) of ``w_H = t - (pp-1)``
+                                          (valid in [nm*(vp-1), nm*vp))
+      backward of work ``u_B = t - D - (pp-1-r)``      (valid in [0, nm*vp))
+      with backward chunk ``c_B = vp-1 - u_B//nm`` descending — the reverse
+      of the forward's circular chunk order.
+    ``T = (2*vp - 1)*nm + 2*pp - 1`` ticks total (the classic
+    ``nm + 2*pp - 1`` at vp == 1).  The head's dy for ``m`` lands in the
+    ``dy_next`` carry at tick ``(vp-1)*nm + m + pp - 1`` and the last rank
+    consumes it one tick later — exactly when its B(vp-1, m) is scheduled.
+    Chunk hand-off rides two circular stores: forward chunk ``c`` -> ``c+1``
+    through ``circ`` on rank 0 (as in the wavefront), backward chunk ``c``
+    -> ``c-1`` through ``bcirc`` on rank ``pp-1`` (rank 0's dgrad output
+    comes around the reverse ring one tick later and waits for chunk
+    ``c-1``'s B tick) — both need ``nm >= pp`` (write-before-read).
+
+    ``zero_bubble`` (vp == 1) splits the backward: the B tick linearizes
+    w.r.t. the activation only (dgrad — the cotangent ring is identical to
+    plain 1F1B, so loss and activation math are bitwise-unchanged), parks
+    ``dy`` in a pp-slot ring, and the weight-gradient pass for ``m`` runs at
+    tick ``m + 2*pp - 1`` on EVERY rank — i.e. ``r`` ticks after rank
+    ``r``'s dgrad, exactly this rank's cooldown-bubble budget (ZB-H1).  The
+    wgrad re-linearizes the stage against the saved input: one extra stage
+    forward per microbatch, the remat trade the cost model prices.
+
+    Every collective (forward ring hop, reverse ring hop, head psums, embed
+    feed and embed-cotangent routing switches) executes unconditionally or
+    under tick-only gates, so all devices always reach the same rendezvous.
     """
     rank = jax.lax.axis_index(PIPE_AXIS)
     is_first = rank == 0
@@ -703,22 +827,87 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
     x0 = emb[0]
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
     reverse = [((i + 1) % pp, i) for i in range(pp)]
-    buf_n = 2 * pp
+    # stage-input save slots: vp == 1 keeps the O(pp) 2*pp ring (a
+    # microbatch's input is consumed at most 2*pp - 1 ticks after its save);
+    # the circular interleave keeps chunk-0 inputs live nearly the whole
+    # schedule, so vp > 1 stores all [vp*nm] work inputs (still below the
+    # wavefront's ~2 residuals per tick — the memory test pins it)
+    buf_n = nm * vp if vp > 1 else 2 * pp
+    dbase = (vp - 1) * nm + pp  # backward schedule offset D
 
-    def stage_flat(lp, x, mb):
-        out = stage_fn(lp, x, {**mb, "_chunk": jnp.zeros((), jnp.int32)})
+    # normalize local layer layout: vp>1 arrives [vp, 1, Lc, ...] (dim1 is
+    # the pipe shard) -> [vp, Lc, ...]; vp==1 stays flat [Lc, ...]
+    if vp > 1:
+        local_layers = jax.tree_util.tree_map(
+            lambda x: jnp.squeeze(x, axis=1), local_layers
+        )
+
+    def chunk_layers(c):
+        if vp == 1:
+            return local_layers
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            local_layers,
+        )
+
+    def stage_flat(lp, x, mb, c):
+        out = stage_fn(lp, x, {**mb, "_chunk": jnp.asarray(c, jnp.int32)})
         if stage_aux:
             return out
         return out, jnp.zeros((), jnp.float32)
 
+    def acc_layers(dl, d_lp, c, bv):
+        """Accumulate a chunk's weight grads (into chunk row c when vp>1)."""
+        if vp == 1:
+            return jax.tree_util.tree_map(
+                lambda a, gkk: a + bv * gkk.astype(grad_dtype), dl, d_lp
+            )
+
+        def one(a, gkk):
+            cur = jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(
+                a, cur + bv * gkk.astype(grad_dtype), c, 0
+            )
+
+        return jax.tree_util.tree_map(one, dl, d_lp)
+
     def tick(carry, t):
-        (recv, cot_recv, dy_next, inflight, d_layers, d_emb, d_w, d_hp_acc,
-         loss_acc, aux_acc) = carry
+        (recv, cot_recv, dy_next, inflight, circ, bcirc, dy_ring, d_layers,
+         d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
+
+        if vp > 1:
+            # forward chunk hand-off (rank 0): recv holds the last rank's
+            # chunk-c output from tick t-1 (work w_back); park it in the
+            # circular store for chunk c+1's slot
+            w_back = t - pp
+            m_back = jnp.clip(jnp.remainder(w_back, nm), 0, nm - 1)
+            back_valid = jnp.logical_and(w_back >= 0,
+                                         w_back < nm * (vp - 1))
+            slot = jax.lax.dynamic_index_in_dim(circ, m_back, 0,
+                                                keepdims=False)
+            circ = jax.lax.dynamic_update_index_in_dim(
+                circ, jnp.where(back_valid, recv, slot), m_back, 0
+            )
+            # backward chunk hand-off (rank pp-1): cot_recv holds rank 0's
+            # chunk-c dgrad from tick t-1 (work u_prev, chunks >= 1 only —
+            # chunk 0's cotangent routes to the embed feed instead); park it
+            # until chunk c-1's B tick
+            u_prev = (t - 1) - dbase - (pp - 1)
+            m_prev = jnp.clip(jnp.remainder(u_prev, nm), 0, nm - 1)
+            prev_valid = jnp.logical_and(u_prev >= 0,
+                                         u_prev < nm * (vp - 1))
+            bslot = jax.lax.dynamic_index_in_dim(bcirc, m_prev, 0,
+                                                 keepdims=False)
+            bcirc = jax.lax.dynamic_update_index_in_dim(
+                bcirc, jnp.where(prev_valid, cot_recv, bslot), m_prev, 0
+            )
 
         # ---- forward ---------------------------------------------------
         w_F = t - rank
-        f_valid = jnp.logical_and(w_F >= 0, w_F < nm)
-        m_F = jnp.clip(w_F, 0, nm - 1)
+        f_valid = jnp.logical_and(w_F >= 0, w_F < nm * vp)
+        w_Fc = jnp.clip(w_F, 0, nm * vp - 1)
+        m_F = jnp.remainder(w_Fc, nm)
+        c_F = w_Fc // nm
         mbF = _tree_index(microbatches, m_F)
         e_t = jax.lax.dynamic_index_in_dim(
             emb, jnp.clip(t // pp, 0, slots - 1), 0, keepdims=False
@@ -733,22 +922,34 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
             ),
             lambda: jnp.zeros(x0.shape, x0.dtype),
         )
-        x_in = jnp.where(is_first, fresh, recv)
-        y, s_aux = stage_flat(local_layers, x_in, mbF)
+        if vp > 1:
+            parked_in = jax.lax.dynamic_index_in_dim(circ, m_F, 0,
+                                                     keepdims=False)
+            first_in = jnp.where(c_F == 0, fresh, parked_in)
+        else:
+            first_in = fresh
+        x_in = jnp.where(is_first, first_in, recv)
+        y, s_aux = stage_flat(chunk_layers(c_F), x_in, mbF, c_F)
         aux_acc = aux_acc + jnp.where(f_valid, s_aux, 0.0)
-        # save the stage input for this rank's B tick (2*pp-slot ring buffer)
-        slot_F = jnp.remainder(m_F, buf_n)
+        # save the stage input for this rank's B tick
+        slot_F = w_Fc if vp > 1 else jnp.remainder(m_F, buf_n)
         old = jax.lax.dynamic_index_in_dim(inflight, slot_F, 0, keepdims=False)
         inflight = jax.lax.dynamic_update_index_in_dim(
             inflight, jnp.where(f_valid, x_in, old), slot_F, 0
         )
 
         # ---- head + CE (vocab sliced over pipe) ------------------------
-        m_H = t - (pp - 1)
-        h_valid = jnp.logical_and(m_H >= 0, m_H < nm)
-        m_Hc = jnp.clip(m_H, 0, nm - 1)
+        w_H = t - (pp - 1)
+        h_valid = jnp.logical_and(w_H >= nm * (vp - 1), w_H < nm * vp)
+        m_Hc = jnp.clip(jnp.remainder(jnp.clip(w_H, 0, nm * vp - 1), nm),
+                        0, nm - 1)
         y_bcast = jax.lax.psum(
-            jnp.where(jnp.logical_and(is_last, f_valid), y, 0.0), PIPE_AXIS
+            jnp.where(
+                jnp.logical_and(is_last,
+                                jnp.logical_and(f_valid, c_F == vp - 1)),
+                y, 0.0,
+            ),
+            PIPE_AXIS,
         )
         mbH = _tree_index(microbatches, m_Hc)
         # hidden fn under vjp over BOTH (hp, y) so the norm-weight grad and
@@ -813,37 +1014,95 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
         )
         dy_new = jnp.where(h_valid, dy_t, jnp.zeros_like(dy_t))
 
-        # ---- backward --------------------------------------------------
-        m_B = t - (2 * pp - 1) + rank
-        b_valid = jnp.logical_and(m_B >= 0, m_B < nm)
-        m_Bc = jnp.clip(m_B, 0, nm - 1)
-        mbB = _tree_index(microbatches, m_Bc)
+        # ---- backward (full vjp, or dgrad-only under zero_bubble) ------
+        u_B = t - dbase - (pp - 1 - rank)
+        b_valid = jnp.logical_and(u_B >= 0, u_B < nm * vp)
+        u_Bc = jnp.clip(u_B, 0, nm * vp - 1)
+        m_B = jnp.remainder(u_Bc, nm)
+        c_B = (vp - 1) - u_Bc // nm
+        mbB = _tree_index(microbatches, m_B)
+        # saved-input slot is keyed by the FORWARD work index c_B*nm + m_B
+        # (the backward order index u_B runs chunks in reverse)
         x_saved = jax.lax.dynamic_index_in_dim(
-            inflight, jnp.remainder(m_Bc, buf_n), 0, keepdims=False
+            inflight,
+            c_B * nm + m_B if vp > 1 else jnp.remainder(m_B, buf_n), 0,
+            keepdims=False,
         )
-        dy_in = jnp.where(is_last, dy_next, cot_recv)
-
-        def stage_for_vjp(lp, x):
-            return stage_flat(lp, x, mbB)
-
-        _, stage_vjp = jax.vjp(stage_for_vjp, local_layers, x_saved)
-        d_lp_t, d_x_t = stage_vjp(
-            (dy_in.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
-        )
+        if vp > 1:
+            last_dy = jnp.where(
+                c_B == vp - 1, dy_next,
+                jax.lax.dynamic_index_in_dim(bcirc, m_B, 0, keepdims=False),
+            )
+        else:
+            last_dy = dy_next
+        dy_in = jnp.where(is_last, last_dy, cot_recv)
+        seed = (dy_in.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
         bv = b_valid.astype(jnp.float32)
-        d_layers = jax.tree_util.tree_map(
-            lambda a, gkk: a + bv * gkk.astype(grad_dtype), d_layers, d_lp_t
-        )
+        lp_B = chunk_layers(c_B)
+
+        if zero_bubble:
+            # dgrad only: the activation cotangent unblocks the upstream
+            # stage this tick; dy parks in the pp-slot ring for the wgrad
+            # pass r ticks later (same dy, same saved input — grads are
+            # bitwise the plain-1F1B split into two pullbacks)
+            _, x_vjp = jax.vjp(lambda x: stage_flat(lp_B, x, mbB, c_B),
+                               x_saved)
+            (d_x_t,) = x_vjp(seed)
+            slot_D = jnp.remainder(m_B, pp)
+            old_dy = jax.lax.dynamic_index_in_dim(dy_ring, slot_D, 0,
+                                                  keepdims=False)
+            dy_ring = jax.lax.dynamic_update_index_in_dim(
+                dy_ring, jnp.where(b_valid, dy_in, old_dy), slot_D, 0
+            )
+        else:
+            def stage_for_vjp(lp, x):
+                return stage_flat(lp, x, mbB, c_B)
+
+            _, stage_vjp = jax.vjp(stage_for_vjp, lp_B, x_saved)
+            d_lp_t, d_x_t = stage_vjp(seed)
+            d_layers = acc_layers(d_layers, d_lp_t, c_B, bv)
         d_x_masked = jnp.where(b_valid, d_x_t, jnp.zeros_like(d_x_t))
 
-        # embed cotangent: rank 0's d_x for microbatch m0 routes back to its
-        # round-robin owner (the reverse of the embed feed), tick-uniform
-        m0 = t - (2 * pp - 1)
+        if zero_bubble:
+            # ---- deferred wgrad (ZB-H1 cooldown fill) ------------------
+            # microbatch m's weight grads on EVERY rank at tick
+            # m + 2*pp - 1 = rank r's dgrad tick + r: the wgrad work slides
+            # into exactly the ticks rank r would idle through in cooldown.
+            # x is still live in the 2*pp inflight ring (overwritten only at
+            # tick m + 2*pp + r) and dy in the pp-slot ring (at m + pp's
+            # dgrad, tick m + 3*pp - 1 - r > this read for every r < pp).
+            m_W = t - (2 * pp - 1)
+            w_valid = jnp.logical_and(m_W >= 0, m_W < nm)
+            m_Wc = jnp.clip(m_W, 0, nm - 1)
+            mbW = _tree_index(microbatches, m_Wc)
+            x_w = jax.lax.dynamic_index_in_dim(
+                inflight, jnp.remainder(m_Wc, buf_n), 0, keepdims=False
+            )
+            dy_w = jax.lax.dynamic_index_in_dim(
+                dy_ring, jnp.remainder(m_Wc, pp), 0, keepdims=False
+            )
+            _, lp_vjp = jax.vjp(
+                lambda lp: stage_flat(lp, x_w, mbW,
+                                      jnp.zeros((), jnp.int32)),
+                local_layers,
+            )
+            (d_lp_w,) = lp_vjp(
+                (dy_w.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
+            )
+            d_layers = acc_layers(d_layers, d_lp_w, 0,
+                                  w_valid.astype(jnp.float32))
+
+        # embed cotangent: rank 0's chunk-0 d_x for microbatch m0 routes
+        # back to its round-robin owner (the reverse of the embed feed),
+        # tick-uniform.  Chunk-0 backwards on rank 0 occupy exactly the
+        # window [off, off + nm).
+        off = 2 * (vp - 1) * nm + 2 * pp - 1
+        m0 = t - off
         m0_valid = jnp.logical_and(m0 >= 0, m0 < nm)
         m0c = jnp.clip(m0, 0, nm - 1)
         d_x0 = jnp.where(is_first, d_x_masked, jnp.zeros_like(d_x_masked))
         routed = jax.lax.cond(
-            jnp.logical_and(t >= 2 * pp - 1, t < nm + 2 * pp - 1),
+            jnp.logical_and(t >= off, t < nm + off),
             lambda: jax.lax.switch(
                 jnp.remainder(m0c, pp),
                 [functools.partial(
@@ -863,11 +1122,17 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
         # ---- ring hops -------------------------------------------------
         recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
         cot_recv = jax.lax.ppermute(d_x_masked, PIPE_AXIS, reverse)
-        return (recv, cot_recv, dy_new, inflight, d_layers, d_emb, d_w,
-                d_hp_acc, loss_acc, aux_acc), None
+        return (recv, cot_recv, dy_new, inflight, circ, bcirc, dy_ring,
+                d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc), None
 
     zeros = jnp.zeros_like(x0)
     inflight0 = jnp.zeros((buf_n,) + x0.shape, x0.dtype)
+    circ0 = (jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1
+             else jnp.zeros((1, 1), x0.dtype))
+    bcirc0 = (jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1
+              else jnp.zeros((1, 1), x0.dtype))
+    dy_ring0 = (jnp.zeros((pp,) + x0.shape, x0.dtype) if zero_bubble
+                else jnp.zeros((1, 1), x0.dtype))
     d_layers0 = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, grad_dtype), local_layers
     )
@@ -877,10 +1142,17 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
         lambda p: jnp.zeros(p.shape, grad_dtype), head_params
     )
     carry0 = (zeros, jnp.zeros_like(x0), jnp.zeros_like(x0), inflight0,
-              d_layers0, d_emb0, d_w0, d_hp0,
+              circ0, bcirc0, dy_ring0, d_layers0, d_emb0, d_w0, d_hp0,
               jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
-    carry, _ = jax.lax.scan(tick, carry0, jnp.arange(nm + 2 * pp - 1))
-    (_, _, _, _, d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
+    carry, _ = jax.lax.scan(
+        tick, carry0, jnp.arange((2 * vp - 1) * nm + 2 * pp - 1)
+    )
+    (_, _, _, _, _, _, _, d_layers, d_emb, d_w, d_hp_acc, loss_acc,
+     aux_acc) = carry
+    if vp > 1:
+        # restore the interleaved [vp, 1, Lc, ...] local layout (dim1 is
+        # this rank's pipe shard) so the out spec reassembles [vp, pp, Lc]
+        d_layers = jax.tree_util.tree_map(lambda x: x[:, None], d_layers)
     aux_total = jax.lax.psum(aux_acc, PIPE_AXIS)
     # loss and head grads are computed identically on every rank (the CE is
     # psum-closed over pipe); d_w is this rank's vocab slice

@@ -228,7 +228,9 @@ class Trainer:
     step: int = 0
     pre_fit: Optional[Callable] = None  # runs once before the loop (DPO ref pass)
     ema_cfg: Optional[Any] = None  # optim.adamw.EMAConfig when EMA is enabled
-    pipeline_schedule: Optional[str] = None  # "1f1b"/"wavefront" under pp, else None
+    # resolved schedule under pp ("1f1b"/"1f1b-interleaved"/"1f1b-zb"/
+    # "wavefront"), else None
+    pipeline_schedule: Optional[str] = None
     # static facts of the run (model family, chips, seq len, analytic FLOPs)
     # persisted with the compile census into run_summary.json
     run_facts: dict = dataclasses.field(default_factory=dict)
@@ -379,6 +381,7 @@ class Trainer:
             from jax.sharding import PartitionSpec as P
 
             from neuronx_distributed_training_tpu.parallel.pipeline import (
+                MANUAL_VJP_SCHEDULES,
                 pipeline_loss,
                 pipeline_loss_and_grad,
                 resolve_schedule,
@@ -559,13 +562,15 @@ class Trainer:
                     )
             eval_loss_fn = loss_fn
 
-            if pp_schedule == "1f1b":
-                # train-step grads come from the manual-vjp 1F1B ring; eval
-                # keeps the autodiff wavefront loss above (it only needs the
-                # forward value).  Family head dispatch: the gate currently
-                # admits llama/mistral only, but route by config type so
-                # re-admitting mixtral (its onef1b_head_hooks are already
-                # wired) needs nothing beyond flipping supports_1f1b.
+            if pp_schedule in MANUAL_VJP_SCHEDULES:
+                # train-step grads come from the manual-vjp tick loop (plain
+                # 1F1B, the circular interleave when vp > 1, or the ZB-H1
+                # dgrad/wgrad split); eval keeps the autodiff wavefront loss
+                # above (it only needs the forward value).  Family head
+                # dispatch: the gate currently admits llama/mistral only, but
+                # route by config type so re-admitting mixtral (its
+                # onef1b_head_hooks are already wired) needs nothing beyond
+                # flipping supports_1f1b.
                 from neuronx_distributed_training_tpu.models import (
                     mixtral as _mixtral_m,
                 )
@@ -589,6 +594,8 @@ class Trainer:
                         head_params=head_params_of(p),
                         head_weight=head_weight_of(p),
                         mesh=mesh, num_microbatches=nm,
+                        virtual_pipeline_size=vp,
+                        zero_bubble=(pp_schedule == "1f1b-zb"),
                         stage_aux=stage_aux, aux_scale=aux_scale,
                         shift_labels=shift_labels,
                     )
@@ -799,12 +806,19 @@ class Trainer:
         if exp.throughput.seq_len == 0:
             exp.throughput.seq_len = seq_len
         n_chips = int(mesh.devices.size)
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            predicted_bubble_fraction,
+        )
+
         run_facts: dict = {
             "model_family": type(model_cfg).__name__,
             "n_chips": n_chips,
             "seq_len": seq_len,
             "global_batch_size": int(sched["global_batch_size"]),
             "pipeline_schedule": pp_schedule,
+            "bubble_fraction_predicted": round(predicted_bubble_fraction(
+                pp_schedule, pp, int(sched["num_microbatches"]),
+                int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)), 6),
         }
         try:
             fwd_flops = _perf.flops_for_model(model_cfg, seq_len)

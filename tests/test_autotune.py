@@ -104,7 +104,18 @@ class TestSpaceLegality:
                 assert facts.seq % p.cp == 0
             assert p.remat in REMAT_POLICIES
             assert p.schedule == "none" if p.pp == 1 else p.schedule in (
-                "1f1b", "wavefront")
+                "1f1b", "1f1b-interleaved", "1f1b-zb", "wavefront")
+            # the interleave carries the vp lattice dimension; everything
+            # else runs vp == 1 (same invariants the runtime raises on)
+            if p.schedule == "1f1b-interleaved":
+                assert p.vp > 1
+                assert p.num_microbatches >= p.pp
+                if facts.moe_frequency > 1:
+                    assert facts.moe_groups % (p.pp * p.vp) == 0
+                else:
+                    assert facts.num_layers % (p.pp * p.vp) == 0
+            else:
+                assert p.vp == 1
 
     def test_no_duplicates_and_deterministic_order(self):
         facts = ModelFacts.from_config(
@@ -137,10 +148,11 @@ class TestSpaceLegality:
 class TestScheduleGate:
     """supports_1f1b is the one source of truth the lattice honors."""
 
-    def test_llama_gets_both_schedules(self):
+    def test_llama_gets_the_manual_vjp_family(self):
         facts = ModelFacts.from_config(load_config(tiny_raw()))
         pp_plans = [p for p in enumerate_plans(facts, 8) if p.pp > 1]
-        assert {p.schedule for p in pp_plans} == {"1f1b", "wavefront"}
+        scheds = {p.schedule for p in pp_plans}
+        assert {"1f1b", "1f1b-zb", "1f1b-interleaved", "wavefront"} <= scheds
 
     def test_mixtral_is_wavefront_only(self):
         facts = ModelFacts.from_config(load_config(tiny_raw(arch="mixtral")))
@@ -229,6 +241,67 @@ class TestCostModel:
                                   micro_batch_size=1, schedule="1f1b"),
             self.topo)
         assert many.bubble_seconds < few.bubble_seconds
+
+    def test_zb_bubble_strictly_below_1f1b(self):
+        """ZB-H1 acceptance bar: at equal (pp, nm) the zero-bubble split's
+        bubble term is strictly below plain 1f1b's (it prices only the
+        warmup third the deferred wgrad tail cannot fill) — while its
+        compute term is strictly above (the re-linearization forward)."""
+        f1b = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="1f1b"),
+            self.topo)
+        zb = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="1f1b-zb"),
+            self.topo)
+        assert zb.bubble_seconds < f1b.bubble_seconds
+        assert zb.compute_seconds > f1b.compute_seconds
+        # at the multiplier level the ratio is exactly the warmup third
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            bubble_multiplier,
+        )
+
+        assert bubble_multiplier("1f1b-zb", 4, 16) == pytest.approx(
+            bubble_multiplier("1f1b", 4, 16) / 3.0)
+
+    def test_wavefront_bubble_divides_by_vp(self):
+        """The satellite fix: wavefront with a virtual pipeline runs the
+        circular interleave (utilization nm*vp/(nm*vp + pp - 1)), so its
+        bubble term divides by nm*vp — not the vp-blind (pp-1)/nm."""
+        flat = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="wavefront"),
+            self.topo)
+        vp2 = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="wavefront",
+                                  vp=2),
+            self.topo)
+        assert vp2.bubble_seconds == pytest.approx(flat.bubble_seconds / 2.0)
+
+    def test_interleaved_bubble_and_ring_memory(self):
+        """1f1b-interleaved divides the bubble by nm*vp and pays for it in
+        chunk-input ring storage (priced as hbm_breakdown['pipeline_rings']),
+        while staying far below the wavefront's per-layer residual class."""
+        f1b = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="1f1b"),
+            self.topo)
+        il = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8,
+                                  schedule="1f1b-interleaved", vp=2),
+            self.topo)
+        wave = estimate_plan(
+            self.facts, self.plan(pp=4, dp=8, num_microbatches=16,
+                                  micro_batch_size=8, schedule="wavefront",
+                                  vp=2),
+            self.topo)
+        assert il.bubble_seconds == pytest.approx(f1b.bubble_seconds / 2.0)
+        assert il.hbm_breakdown["pipeline_rings"] > 0
+        assert il.hbm_bytes > f1b.hbm_bytes
+        assert il.hbm_bytes < wave.hbm_bytes
 
     def test_wavefront_costs_more_memory_at_depth(self):
         onef1b = estimate_plan(

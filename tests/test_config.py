@@ -350,10 +350,39 @@ class TestPipelineScheduleKnob:
                         "model.lora.r": 8})
 
     def test_valid_schedules_load(self):
-        for sched in ("auto", "1f1b", "wavefront"):
+        for sched in ("auto", "1f1b", "1f1b-zb", "wavefront"):
             load_config(self._base(
                 **{"distributed_strategy.pipeline.schedule": sched,
                    "distributed_strategy.pipeline_model_parallel_size": 2}))
+
+    def test_interleaved_loads_with_vp(self):
+        load_config(self._base(
+            **{"distributed_strategy.pipeline.schedule": "1f1b-interleaved",
+               "distributed_strategy.pipeline_model_parallel_size": 2,
+               "distributed_strategy.virtual_pipeline_model_parallel_size": 2,
+               "model.num_layers": 4}))
+
+    def test_interleaved_rejects_vp1(self):
+        self._expect("nothing to interleave",
+                     **{"distributed_strategy.pipeline.schedule":
+                        "1f1b-interleaved",
+                        "distributed_strategy.pipeline_model_parallel_size": 2})
+
+    def test_zb_rejects_vp(self):
+        self._expect("1f1b-interleaved",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b-zb",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "distributed_strategy."
+                        "virtual_pipeline_model_parallel_size": 2,
+                        "model.num_layers": 4})
+
+    def test_zb_rejects_cp(self):
+        self._expect("context parallelism",
+                     **{"distributed_strategy.pipeline.schedule": "1f1b-zb",
+                        "distributed_strategy.pipeline_model_parallel_size": 2,
+                        "distributed_strategy.context_parallel_size": 2,
+                        "model.fusions.ring_attention": True,
+                        "data.seq_length": 1024})
 
 
 class TestUnknownKnobRejection:

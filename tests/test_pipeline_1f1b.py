@@ -26,11 +26,15 @@ from neuronx_distributed_training_tpu.models import llama
 from neuronx_distributed_training_tpu.parallel import sharding as shd
 from neuronx_distributed_training_tpu.parallel.mesh import MeshConfig, build_mesh
 from neuronx_distributed_training_tpu.parallel.pipeline import (
+    MANUAL_VJP_SCHEDULES,
     PIPELINE_SCHEDULES,
+    bubble_multiplier,
     pipeline_loss,
     pipeline_loss_and_grad,
+    predicted_bubble_fraction,
     resolve_schedule,
     supports_1f1b,
+    to_interleaved,
 )
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
@@ -69,8 +73,15 @@ def microbatches(key, nm=4, mb=4, s=16, vocab=128):
     return {"input_ids": ids, "labels": ids}
 
 
-def shard_for(mesh, cfg, params, mbs, specs=None):
+def shard_for(mesh, cfg, params, mbs, specs=None, vp=1):
     specs = specs if specs is not None else llama.param_specs(cfg, pipeline=True)
+    if vp > 1:
+        pp = int(mesh.shape.get("pipe", 1))
+        params = {**params, "layers": to_interleaved(params["layers"], pp, vp)}
+        specs = dict(specs)
+        specs["layers"] = jax.tree_util.tree_map(
+            lambda s: P(None, s[0], None, *tuple(s)[1:]), specs["layers"],
+            is_leaf=lambda x: isinstance(x, P))
     ns = functools.partial(NamedSharding, mesh)
     sh_params = jax.device_put(
         params, jax.tree_util.tree_map(ns, specs, is_leaf=lambda x: isinstance(x, P))
@@ -129,9 +140,38 @@ class TestSupports1F1B:
         ok, reason = supports_1f1b(CFG, _pcfg(pp=1))
         assert not ok and "pipeline_model_parallel_size" in reason
 
-    def test_vp_unsupported(self):
+    def test_plain_1f1b_rejects_vp_naming_interleaved(self):
+        """The vp>1 message points at the interleaved schedule now — not at
+        the autodiff wavefront (satellite: stale-message fix)."""
         ok, reason = supports_1f1b(CFG, _pcfg(pp=2, vp=2))
-        assert not ok and "virtual" in reason
+        assert not ok and "1f1b-interleaved" in reason
+        assert "wavefront" not in reason
+
+    def test_interleaved_supported_with_vp(self):
+        ok, reason = supports_1f1b(CFG, _pcfg(pp=2, vp=2),
+                                   "1f1b-interleaved")
+        assert ok, reason
+
+    def test_interleaved_needs_vp(self):
+        ok, reason = supports_1f1b(CFG, _pcfg(pp=2), "1f1b-interleaved")
+        assert not ok and "nothing to interleave" in reason
+
+    def test_zb_supported_at_vp1_only(self):
+        ok, reason = supports_1f1b(CFG, _pcfg(pp=2), "1f1b-zb")
+        assert ok, reason
+        ok, reason = supports_1f1b(CFG, _pcfg(pp=2, vp=2), "1f1b-zb")
+        assert not ok and "1f1b-interleaved" in reason
+
+    @pytest.mark.parametrize("sched", MANUAL_VJP_SCHEDULES)
+    def test_cp_blocks_every_manual_vjp_schedule(self, sched):
+        pcfg = dict(_pcfg(pp=2, vp=2 if sched == "1f1b-interleaved" else 1),
+                    context_parallel_size=2)
+        ok, reason = supports_1f1b(CFG, pcfg, sched)
+        assert not ok and "context" in reason
+
+    def test_non_manual_schedule_rejected_by_gate(self):
+        with pytest.raises(ValueError, match="manual-vjp"):
+            supports_1f1b(CFG, _pcfg(pp=2), "wavefront")
 
     def test_cp_unsupported(self):
         pcfg = dict(_pcfg(pp=2), context_parallel_size=2)
@@ -193,8 +233,27 @@ class TestResolveSchedule:
     def test_auto_picks_1f1b_when_supported(self):
         assert resolve_schedule("auto", CFG, _pcfg(pp=2)) == "1f1b"
 
+    def test_auto_picks_interleaved_under_vp(self):
+        assert resolve_schedule("auto", CFG, _pcfg(pp=2, vp=2)) \
+            == "1f1b-interleaved"
+
     def test_auto_falls_back_to_wavefront(self):
-        assert resolve_schedule("auto", CFG, _pcfg(pp=2, vp=2)) == "wavefront"
+        pcfg = dict(_pcfg(pp=2, vp=2), context_parallel_size=2)
+        assert resolve_schedule("auto", CFG, pcfg) == "wavefront"
+
+    def test_auto_never_picks_zb(self):
+        """zb trades recompute for bubble — a per-plan call the autotune
+        cost model prices; auto stays on the no-extra-compute default."""
+        assert resolve_schedule("auto", CFG, _pcfg(pp=2)) == "1f1b"
+
+    def test_forced_interleaved_and_zb(self):
+        assert resolve_schedule("1f1b-interleaved", CFG, _pcfg(pp=2, vp=2)) \
+            == "1f1b-interleaved"
+        assert resolve_schedule("1f1b-zb", CFG, _pcfg(pp=2)) == "1f1b-zb"
+        with pytest.raises(ValueError, match="nothing to interleave"):
+            resolve_schedule("1f1b-interleaved", CFG, _pcfg(pp=2))
+        with pytest.raises(ValueError, match="1f1b-interleaved"):
+            resolve_schedule("1f1b-zb", CFG, _pcfg(pp=2, vp=2))
 
     def test_forced_wavefront_always_wins(self):
         assert resolve_schedule("wavefront", CFG, _pcfg(pp=2)) == "wavefront"
@@ -211,7 +270,9 @@ class TestResolveSchedule:
     def test_unknown_schedule_rejected(self):
         with pytest.raises(ValueError, match="pipeline.schedule"):
             resolve_schedule("gpipe", CFG, _pcfg(pp=2))
-        assert PIPELINE_SCHEDULES == ("auto", "1f1b", "wavefront")
+        assert PIPELINE_SCHEDULES == ("auto", "1f1b", "1f1b-interleaved",
+                                      "1f1b-zb", "wavefront")
+        assert MANUAL_VJP_SCHEDULES == ("1f1b", "1f1b-interleaved", "1f1b-zb")
 
     def test_default_none_means_auto(self):
         assert resolve_schedule(None, CFG, _pcfg(pp=2)) == "1f1b"
@@ -325,6 +386,164 @@ class TestParity:
                 mesh=None)
 
 
+class TestParityNewSchedules:
+    """The circular interleaved 1F1B and the ZB-H1 split must hold the SAME
+    parity bar as plain 1F1B: loss + all grad families vs wavefront +
+    ``jax.grad`` at the pinned tolerances.  The wavefront reference runs with
+    the identical vp (so both sides consume the identical interleaved layer
+    layout and chunk schedule)."""
+
+    def _compare(self, cfg, pp, vp, nm, *, zb=False, loss_mask=False,
+                 tied=False):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, tie_word_embeddings=tied)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = dict(microbatches(jax.random.PRNGKey(1), nm=nm,
+                                vocab=cfg.vocab_size))
+        if loss_mask:
+            mask = np.ones(np.asarray(mbs["input_ids"]).shape, np.float32)
+            mask[0, :, :8] = 0.0
+            mbs["loss_mask"] = jnp.asarray(mask)
+        hooks = llama.pipeline_hooks(cfg, FP32)
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=pp,
+                                     virtual_pipeline_model_parallel_size=vp))
+        sh_params, sh_mbs = shard_for(mesh, cfg, params, mbs, vp=vp)
+
+        ref_l, ref_g = wavefront_loss_and_grad(
+            mesh, hooks, sh_params, sh_mbs, virtual_pipeline_size=vp)
+        loss, g = onef1b_loss_and_grad(
+            mesh, cfg, hooks, sh_params, sh_mbs,
+            virtual_pipeline_size=vp, zero_bubble=zb)
+        tag = f"(pp={pp}, vp={vp}, nm={nm}, zb={zb}, tied={tied})"
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5,
+                                   err_msg=tag)
+        assert_path_close(g["layers"], ref_g["layers"],
+                          tuple(p[1:] for p in GRAD_PATHS), tag=tag)
+        np.testing.assert_allclose(
+            np.asarray(g["head_params"]["final_norm"]["scale"]),
+            np.asarray(ref_g["final_norm"]["scale"]), rtol=5e-4, atol=1e-5,
+            err_msg=tag)
+        d_embed = np.asarray(g["params_from_embed"]["embed"]["embedding"])
+        if tied:
+            np.testing.assert_allclose(
+                d_embed + np.asarray(g["head_weight"]),
+                np.asarray(ref_g["embed"]["embedding"]), rtol=5e-4,
+                atol=1e-5, err_msg=tag)
+        else:
+            np.testing.assert_allclose(
+                d_embed, np.asarray(ref_g["embed"]["embedding"]),
+                rtol=5e-4, atol=1e-5, err_msg=tag)
+            np.testing.assert_allclose(
+                np.asarray(g["head_weight"]).T,
+                np.asarray(ref_g["lm_head"]["w"]), rtol=5e-4, atol=1e-5,
+                err_msg=tag)
+
+    @pytest.mark.parametrize("pp,nm,tied", [
+        (2, 4, False), (2, 4, True), (2, 6, False), (4, 6, False),
+    ])
+    def test_interleaved_parity(self, devices8, pp, nm, tied):
+        """vp=2 circular interleave at pp in {2, 4}, incl. nm % pp != 0 and
+        tied embeddings.  pp=4 x vp=2 needs an 8-layer stack."""
+        import dataclasses
+
+        cfg = (dataclasses.replace(CFG, num_layers=8) if pp == 4 else CFG)
+        self._compare(cfg, pp=pp, vp=2, nm=nm, tied=tied)
+
+    @pytest.mark.parametrize("pp,nm,tied", [
+        (2, 4, True), (2, 6, False), (4, 4, False), (4, 6, False),
+    ])
+    def test_zb_parity(self, devices8, pp, nm, tied):
+        """ZB-H1 dgrad/wgrad split at pp in {2, 4}, incl. nm % pp != 0 and
+        tied embeddings."""
+        self._compare(CFG, pp=pp, vp=1, nm=nm, zb=True, tied=tied)
+
+    def test_interleaved_loss_mask(self, devices8):
+        self._compare(CFG, pp=2, vp=2, nm=4, loss_mask=True)
+
+    def test_zb_loss_mask(self, devices8):
+        self._compare(CFG, pp=2, vp=1, nm=4, zb=True, loss_mask=True)
+
+    def test_zb_rejects_vp(self, devices8):
+        hooks = llama.pipeline_hooks(CFG, FP32)
+        embed_fn, stage_fn, _ = hooks
+        hh, hp_of, hw_of, _fold = llama.onef1b_head_hooks(CFG, FP32)
+        params = llama.init_params(jax.random.PRNGKey(0), CFG, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1), nm=4)
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2,
+                                     virtual_pipeline_model_parallel_size=2))
+        sh_params, sh_mbs = shard_for(mesh, CFG, params, mbs, vp=2)
+        with pytest.raises(ValueError, match="vp == 1 only"):
+            pipeline_loss_and_grad(
+                sh_params, sh_params["layers"], sh_mbs, embed_fn=embed_fn,
+                stage_fn=stage_fn, head_hidden_fn=hh,
+                head_params=hp_of(sh_params), head_weight=hw_of(sh_params),
+                mesh=mesh, virtual_pipeline_size=2, zero_bubble=True)
+
+    def test_interleaved_needs_nm_ge_pp(self, devices8):
+        """nm < pp would read the circular stores before their writes —
+        must die loudly (same hazard rule the wavefront enforces)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, num_layers=8)
+        hooks = llama.pipeline_hooks(cfg, FP32)
+        embed_fn, stage_fn, _ = hooks
+        hh, hp_of, hw_of, _fold = llama.onef1b_head_hooks(cfg, FP32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1), nm=2)
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=4,
+                                     virtual_pipeline_model_parallel_size=2))
+        sh_params, sh_mbs = shard_for(mesh, cfg, params, mbs, vp=2)
+        with pytest.raises(ValueError, match="num_microbatches >= pp"):
+            pipeline_loss_and_grad(
+                sh_params, sh_params["layers"], sh_mbs, embed_fn=embed_fn,
+                stage_fn=stage_fn, head_hidden_fn=hh,
+                head_params=hp_of(sh_params), head_weight=hw_of(sh_params),
+                mesh=mesh, virtual_pipeline_size=2)
+
+
+class TestBubbleModel:
+    """The one bubble table telemetry, bench, and the autotune cost model
+    share (``bubble_multiplier`` / ``predicted_bubble_fraction``)."""
+
+    def test_classic_1f1b_and_wavefront(self):
+        assert bubble_multiplier("1f1b", 4, 8) == pytest.approx(3 / 8)
+        assert bubble_multiplier("wavefront", 4, 8) == pytest.approx(3 / 8)
+
+    def test_wavefront_vp_divides(self):
+        """The satellite fix: vp>1 wavefront utilization is
+        nm*vp/(nm*vp + pp - 1), so the multiplier divides by nm*vp."""
+        assert bubble_multiplier("wavefront", 4, 8, vp=2) \
+            == pytest.approx(3 / 16)
+
+    def test_interleaved_divides_by_nm_vp(self):
+        assert bubble_multiplier("1f1b-interleaved", 4, 8, vp=2) \
+            == pytest.approx(3 / 16)
+        assert bubble_multiplier("1f1b-interleaved", 4, 8, vp=4) \
+            == pytest.approx(3 / 32)
+
+    def test_zb_is_the_warmup_third(self):
+        assert bubble_multiplier("1f1b-zb", 4, 8) == pytest.approx(1 / 8)
+        # strictly below plain 1f1b at every equal (pp, nm)
+        for pp in (2, 4, 8):
+            for nm in (4, 16, 64):
+                assert bubble_multiplier("1f1b-zb", pp, nm) \
+                    < bubble_multiplier("1f1b", pp, nm)
+
+    def test_degenerate_cases(self):
+        assert bubble_multiplier("1f1b", 1, 8) == 0.0
+        assert bubble_multiplier(None, 4, 0) == 0.0
+        assert predicted_bubble_fraction("none", 1, 8) == 0.0
+
+    def test_fraction_is_of_total_step(self):
+        b = bubble_multiplier("1f1b", 4, 8)
+        assert predicted_bubble_fraction("1f1b", 4, 8) \
+            == pytest.approx(b / (1 + b))
+        # utilization identity: 1 - fraction == nm*vp/(nm*vp + pp - 1)
+        assert 1 - predicted_bubble_fraction("wavefront", 4, 8, vp=2) \
+            == pytest.approx(16 / 19)
+
+
 class TestMemoryBound:
     """The schedule's reason to exist, pinned via compiled memory analysis.
 
@@ -388,12 +607,70 @@ class TestMemoryBound:
         assert temps[8][1] < temps[8][0], detail
 
 
+    def test_schedule_memory_comparison(self, devices8):
+        """The ISSUE's schedule-comparison bars on compiled peak temp bytes:
+        zb stays within 1.15x plain 1F1B (its extra state is one pp-slot dy
+        ring + the wgrad re-linearization workspace), and the interleave
+        stays at-or-under the autodiff wavefront at the SAME vp (chunk-input
+        rings vs ~2 per-layer residuals per work item)."""
+        import dataclasses
+
+        from tests.conftest import lower_in_mesh
+
+        cfg = dataclasses.replace(
+            CFG, vocab_size=64, hidden_size=256, intermediate_size=256,
+            num_attention_heads=2, num_kv_heads=2, max_position_embeddings=128,
+        )
+        mb, s, nm = 8, 128, 8
+        embed_fn, stage_fn, loss_fn = llama.pipeline_hooks(cfg, FP32)
+        hh, hp_of, hw_of, _fold = llama.onef1b_head_hooks(cfg, FP32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mbs = microbatches(jax.random.PRNGKey(1), nm=nm, mb=mb, s=s,
+                           vocab=cfg.vocab_size)
+
+        def peak(mesh, sh_params, sh_mbs, *, vp=1, zb=False, wavefront=False):
+            if wavefront:
+                def fn(p, m):
+                    return pipeline_loss(
+                        p, p["layers"], m, embed_fn=embed_fn,
+                        stage_fn=stage_fn, loss_fn=loss_fn, mesh=mesh,
+                        virtual_pipeline_size=vp)
+                low = lower_in_mesh(mesh, jax.value_and_grad(fn),
+                                    sh_params, sh_mbs)
+            else:
+                def fn(p, m):
+                    return pipeline_loss_and_grad(
+                        p, p["layers"], m, embed_fn=embed_fn,
+                        stage_fn=stage_fn, head_hidden_fn=hh,
+                        head_params=hp_of(p), head_weight=hw_of(p),
+                        mesh=mesh, virtual_pipeline_size=vp, zero_bubble=zb)
+                low = lower_in_mesh(mesh, fn, sh_params, sh_mbs)
+            return low.memory_analysis().temp_size_in_bytes
+
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2))
+        sh_params, sh_mbs = shard_for(mesh, cfg, params, mbs)
+        f1b = peak(mesh, sh_params, sh_mbs)
+        zb = peak(mesh, sh_params, sh_mbs, zb=True)
+
+        mesh_vp = build_mesh(MeshConfig(
+            pipeline_model_parallel_size=2,
+            virtual_pipeline_model_parallel_size=2))
+        shp_vp, shm_vp = shard_for(mesh_vp, cfg, params, mbs, vp=2)
+        il = peak(mesh_vp, shp_vp, shm_vp, vp=2)
+        wf_vp = peak(mesh_vp, shp_vp, shm_vp, vp=2, wavefront=True)
+
+        detail = {"f1b": f1b, "zb": zb, "interleaved": il,
+                  "wavefront_vp": wf_vp}
+        assert zb <= 1.15 * f1b, detail
+        assert il <= wf_vp, detail
+
+
 class TestTrainerDispatch:
     """The trainer builds the 1F1B loss+grad when the gate fires, feeding the
     identical AdamW/ZeRO-1 + metrics + grad-pinning path — one step under
     each schedule must produce the same loss AND grad_norm."""
 
-    def _cfg(self, schedule, arch_overrides=None):
+    def _cfg(self, schedule, arch_overrides=None, vp=1):
         cfg = {
             "name": f"f1b_dispatch_{schedule}",
             "model_source": "hf",
@@ -401,6 +678,7 @@ class TestTrainerDispatch:
             "trainer": {"max_steps": 1, "log_every_n_steps": 1},
             "distributed_strategy": {
                 "pipeline_model_parallel_size": 2,
+                "virtual_pipeline_model_parallel_size": vp,
                 "pipeline": {"schedule": schedule},
             },
             "data": {"global_batch_size": 8, "micro_batch_size": 1,
@@ -419,11 +697,11 @@ class TestTrainerDispatch:
             cfg["model"].update(arch_overrides)
         return cfg
 
-    def _one_step(self, schedule):
+    def _one_step(self, schedule, vp=1):
         from neuronx_distributed_training_tpu.config.loader import load_config
         from neuronx_distributed_training_tpu.trainer.loop import Trainer
 
-        t = Trainer.from_config(load_config(self._cfg(schedule)),
+        t = Trainer.from_config(load_config(self._cfg(schedule, vp=vp)),
                                 enable_checkpointing=False)
         batch = next(t.data_module.sharded_batches(t.mesh))
         with t.mesh, shd.use_mesh(t.mesh):
@@ -438,6 +716,22 @@ class TestTrainerDispatch:
         np.testing.assert_allclose(m_f["loss"], m_w["loss"], rtol=1e-5)
         np.testing.assert_allclose(m_f["grad_norm"], m_w["grad_norm"], rtol=1e-4)
 
+    def test_zb_produces_identical_step(self, devices8):
+        sched_z, m_z = self._one_step("1f1b-zb")
+        sched_f, m_f = self._one_step("1f1b")
+        assert sched_z == "1f1b-zb"
+        np.testing.assert_allclose(m_z["loss"], m_f["loss"], rtol=1e-5)
+        np.testing.assert_allclose(m_z["grad_norm"], m_f["grad_norm"],
+                                   rtol=1e-4)
+
+    def test_interleaved_produces_identical_step(self, devices8):
+        sched_i, m_i = self._one_step("1f1b-interleaved", vp=2)
+        sched_w, m_w = self._one_step("wavefront", vp=2)
+        assert sched_i == "1f1b-interleaved" and sched_w == "wavefront"
+        np.testing.assert_allclose(m_i["loss"], m_w["loss"], rtol=1e-5)
+        np.testing.assert_allclose(m_i["grad_norm"], m_w["grad_norm"],
+                                   rtol=1e-4)
+
     def test_auto_resolves_to_1f1b(self, devices8):
         from neuronx_distributed_training_tpu.config.loader import load_config
         from neuronx_distributed_training_tpu.trainer.loop import Trainer
@@ -445,6 +739,20 @@ class TestTrainerDispatch:
         t = Trainer.from_config(load_config(self._cfg("auto")),
                                 enable_checkpointing=False)
         assert t.pipeline_schedule == "1f1b"
+
+    def test_auto_resolves_to_interleaved_under_vp(self, devices8):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        t = Trainer.from_config(load_config(self._cfg("auto", vp=2)),
+                                enable_checkpointing=False)
+        assert t.pipeline_schedule == "1f1b-interleaved"
+        # telemetry: the resolved schedule + the cost model's bubble
+        # prediction ride run_facts into run_summary.json
+        assert t.run_facts["pipeline_schedule"] == "1f1b-interleaved"
+        nm = 2  # gbs=8, mbs=1, dp=4 (8 devices / pp=2)
+        assert t.run_facts["bubble_fraction_predicted"] == pytest.approx(
+            predicted_bubble_fraction("1f1b-interleaved", 2, nm, 2), abs=1e-6)
 
     def test_forced_1f1b_on_gpt_raises(self, devices8):
         """The family gate fires at trainer build with the gate's reason —

@@ -165,7 +165,8 @@ def census_section(summary: dict) -> str:
                      + (", ".join(f"{k}={v}" for k, v in sorted(nonzero.items()))
                         or "none"))
     for key in ("model_family", "n_chips", "seq_len", "global_batch_size",
-                "pipeline_schedule", "fwd_flops_per_token",
+                "pipeline_schedule", "bubble_fraction_predicted",
+                "fwd_flops_per_token",
                 "train_step_flops_per_token", "peak_tflops_per_chip"):
         if summary.get(key) is not None:
             v = summary[key]
