@@ -51,17 +51,32 @@ LAST_MEASURED = {
 
 _LAST_MEASURED_PATH = "bench_results/last_measured.json"
 _MEASURED_LOG = "bench_results/r5_measured.jsonl"
+# the last completed preemption drill (tools/elastic_drill.py writes it);
+# when present its restart cost + goodput ride the bench JSON line so fleet
+# survivability is visible in the bench trajectory (docs/elasticity.md)
+_LAST_DRILL_PATH = "bench_results/last_drill.json"
 
 
-def load_last_measured() -> dict:
+def _read_repo_json(rel_path: str, default):
+    """One loader for the bench_results/*.json snapshots (repo-relative;
+    missing/corrupt/non-dict files fall back to ``default``)."""
     import os
 
     base = os.path.dirname(os.path.abspath(__file__))
     try:
-        with open(os.path.join(base, _LAST_MEASURED_PATH)) as f:
-            return json.load(f)
+        with open(os.path.join(base, rel_path)) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else default
     except Exception:
-        return LAST_MEASURED
+        return default
+
+
+def load_last_drill() -> dict:
+    return _read_repo_json(_LAST_DRILL_PATH, {})
+
+
+def load_last_measured() -> dict:
+    return _read_repo_json(_LAST_MEASURED_PATH, LAST_MEASURED)
 
 
 def record_measurement(payload: dict, refresh_last: bool = True) -> None:
@@ -887,6 +902,17 @@ def main() -> None:
             # survive a planner failure
             payload["plan_topk"] = {"error": f"{type(e).__name__}: {e}"[:500]}
             log(f"bench: plan-topk failed: {payload['plan_topk']['error']}")
+    drill = load_last_drill()
+    if drill.get("ok"):
+        # elastic-resume drill trail (tools/elastic_drill.py): restart cost
+        # and post-resume goodput from the last completed drill
+        payload["restart_cost_seconds"] = drill.get("restart_cost_seconds")
+        payload["goodput_fraction"] = drill.get("goodput_fraction")
+        payload["drill"] = {
+            k: drill.get(k)
+            for k in ("date", "mode", "phase", "world", "resume_world",
+                      "replanned", "max_loss_diff")
+        }
     if errors:
         payload["regime_errors"] = errors
     if backend_err:

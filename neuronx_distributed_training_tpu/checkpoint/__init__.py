@@ -12,4 +12,5 @@ from neuronx_distributed_training_tpu.checkpoint.manager import (  # noqa: F401
     CheckpointConfig,
     Checkpointer,
     TrainState,
+    is_transient_save_error,
 )

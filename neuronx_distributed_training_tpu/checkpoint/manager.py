@@ -21,12 +21,42 @@ Capability map to the reference (SURVEY.md §5.4):
 from __future__ import annotations
 
 import dataclasses
+import errno
+import logging
+import time
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+#: errno values treated as TRANSIENT save-I/O failures (full disk being
+#: cleaned by retention, a flaky NFS/FUSE mount, an object-store hiccup) —
+#: worth a bounded retry with backoff.  Anything else (bad tree, permission,
+#: programming error) re-raises immediately.
+TRANSIENT_SAVE_ERRNOS = frozenset({
+    errno.ENOSPC, errno.EIO, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT,
+    errno.EINTR, errno.EDQUOT,
+})
+
+
+def is_transient_save_error(exc: BaseException) -> bool:
+    """Is ``exc`` (or anything in its cause/context chain) a transient I/O
+    error worth retrying?  Orbax wraps the underlying ``OSError`` in its own
+    exception types, so the chain is walked, not just the top."""
+    seen: set[int] = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, TimeoutError):
+            return True
+        if isinstance(cur, OSError) and cur.errno in TRANSIENT_SAVE_ERRNOS:
+            return True
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,11 +78,25 @@ class CheckpointConfig:
     # checkpoint.  Default True here (bitwise resume); False drops the master
     # tree from the save and restore re-seeds it from the saved params.
     use_master_weights_in_ckpt: bool = True
+    # elastic-resume hardening (``exp_manager.elastic``, docs/elasticity.md):
+    # bounded retry with exponential backoff on TRANSIENT save I/O errors
+    # (ENOSPC/EIO/...), with partial-save cleanup so a failed save never
+    # shadows the last good one
+    save_retries: int = 3
+    save_retry_backoff_seconds: float = 0.5
 
     @classmethod
     def from_config(cls, cfg: dict[str, Any]) -> "CheckpointConfig":
         em = dict(cfg.get("exp_manager", {}) or {})
         cb = dict(em.get("checkpoint_callback_params", {}) or {})
+        # retry knobs flow through the validated exp_manager.elastic block —
+        # ElasticConfig owns the defaults (trainer/elastic.py ELASTIC_KNOBS),
+        # so the checkpointer cannot diverge from the documented knob block
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            ElasticConfig,
+        )
+
+        el = ElasticConfig.from_config(em.get("elastic"))
         return cls(
             dir=em.get("explicit_log_dir") or em.get("exp_dir") or "checkpoints",
             save_top_k=int(cb.get("save_top_k", 3)),
@@ -62,6 +106,8 @@ class CheckpointConfig:
             save_bf16=bool(em.get("save_bf16", cb.get("save_bf16", False))),
             use_master_weights_in_ckpt=bool(
                 cb.get("use_master_weights_in_ckpt", True)),
+            save_retries=el.save_retries,
+            save_retry_backoff_seconds=el.save_retry_backoff_seconds,
         )
 
 
@@ -143,33 +189,70 @@ class Checkpointer:
     def __init__(self, config: CheckpointConfig, *, keep_last: bool = True):
         self.config = config
         directory = resolve_checkpoint_dir(config.dir)
-        preservation = None
-        if config.save_top_k > 0:
-            from orbax.checkpoint.checkpoint_managers import preservation_policy as pp
+        try:
+            from orbax.checkpoint.checkpoint_managers import (  # noqa: F401
+                preservation_policy as _pp,
+            )
 
-            def metric_fn(metrics: Any) -> float:
-                return float((metrics or {}).get(self.config.monitor, float("inf")))
+            have_preservation = True
+        except Exception:  # noqa: BLE001 — older orbax: module absent
+            have_preservation = False
+        #: does this orbax ship the preservation-policy retention API?
+        #: (best-N-by-metric + latest).  Without it we degrade to newest-N
+        #: retention instead of refusing to construct — an elastic resume on
+        #: an old image must still be able to save and restore.
+        self.preservation_api = have_preservation
 
-            policies = [
-                # reverse=True keeps the *lowest* metric values (loss-like)
-                pp.BestN(get_metric_fn=metric_fn, n=config.save_top_k, reverse=True),
-            ]
-            if keep_last:
-                # "last" must survive top-k eviction for auto-resume correctness
-                # (the reference keeps top-k AND last, exp_manager.py:517-579)
-                policies.append(pp.LatestN(n=1))
-            preservation = pp.AnyPreservationPolicy(policies)
+        if have_preservation:
+            preservation = None
+            if config.save_top_k > 0:
+                from orbax.checkpoint.checkpoint_managers import (
+                    preservation_policy as pp,
+                )
 
-        options = ocp.CheckpointManagerOptions(
-            preservation_policy=preservation,
-            enable_async_checkpointing=config.async_save,
-            save_interval_steps=1,  # step gating is the trainer's job
-        )
+                def metric_fn(metrics: Any) -> float:
+                    return float((metrics or {}).get(self.config.monitor, float("inf")))
+
+                policies = [
+                    # reverse=True keeps the *lowest* metric values (loss-like)
+                    pp.BestN(get_metric_fn=metric_fn, n=config.save_top_k, reverse=True),
+                ]
+                if keep_last:
+                    # "last" must survive top-k eviction for auto-resume correctness
+                    # (the reference keeps top-k AND last, exp_manager.py:517-579)
+                    policies.append(pp.LatestN(n=1))
+                preservation = pp.AnyPreservationPolicy(policies)
+
+            options = ocp.CheckpointManagerOptions(
+                preservation_policy=preservation,
+                enable_async_checkpointing=config.async_save,
+                save_interval_steps=1,  # step gating is the trainer's job
+            )
+        else:
+            # legacy retention: newest (top_k + 1) checkpoints — the "+1"
+            # approximates the keep-last guarantee; best-by-metric needs the
+            # preservation API (those tests stay environment-gated)
+            if config.save_top_k > 0:
+                logger.warning(
+                    "orbax without preservation_policy: retention degrades "
+                    "to newest-%d (best-by-%s needs a newer orbax)",
+                    config.save_top_k + int(keep_last), config.monitor,
+                )
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=(config.save_top_k + int(keep_last)
+                             if config.save_top_k > 0 else None),
+                enable_async_checkpointing=config.async_save,
+                save_interval_steps=1,
+            )
         self._mgr = ocp.CheckpointManager(directory, options=options)
 
     @property
-    def directory(self) -> Path:
-        return Path(self._mgr.directory)
+    def directory(self):
+        """Local dirs as ``pathlib.Path``; remote stores keep orbax's
+        ``epath.Path`` — re-wrapping in ``Path()`` would mangle ``gs://``
+        into ``gs:/`` and make every ``exists()``/``glob()`` a silent no-op."""
+        d = self._mgr.directory
+        return d if "://" in str(d) else Path(str(d))
 
     # -- save ---------------------------------------------------------------
 
@@ -179,7 +262,15 @@ class Checkpointer:
         *,
         metrics: Optional[dict[str, float]] = None,
         force: bool = False,
+        manifest: Optional[dict[str, Any]] = None,
     ) -> bool:
+        """Schedule (async) or perform (sync) one save.
+
+        ``manifest`` — the world-size-agnostic topology/plan manifest
+        (``trainer.elastic.build_manifest``): mesh axes, parallelism plan,
+        model identity.  Stored as its own JSON item so a restart can read
+        it WITHOUT templates (the restart-time replanner does exactly that
+        before any model state exists)."""
         params = state.params
         if self.config.save_bf16:
             import jax.numpy as jnp
@@ -200,16 +291,131 @@ class Checkpointer:
             "master_in_ckpt": "master" in opt_state,
             **{k: v for k, v in state.extra.items()},
         }
+        items: dict[str, Any] = {
+            "params": ocp.args.StandardSave(params),
+            "opt_state": ocp.args.StandardSave(opt_state),
+            "meta": ocp.args.JsonSave(meta),
+        }
+        if manifest is not None:
+            items["manifest"] = ocp.args.JsonSave(manifest)
         return self._mgr.save(
             int(state.step),
-            args=ocp.args.Composite(
-                params=ocp.args.StandardSave(params),
-                opt_state=ocp.args.StandardSave(opt_state),
-                meta=ocp.args.JsonSave(meta),
-            ),
+            args=ocp.args.Composite(**items),
             metrics={k: float(v) for k, v in (metrics or {}).items()},
             force=force,
         )
+
+    def save_with_retry(
+        self,
+        state: TrainState,
+        *,
+        metrics: Optional[dict[str, float]] = None,
+        force: bool = False,
+        manifest: Optional[dict[str, Any]] = None,
+        retries: Optional[int] = None,
+        backoff_seconds: Optional[float] = None,
+        deadline: Optional[float] = None,
+        drain: bool = False,
+    ) -> bool:
+        """:meth:`save` with bounded retry + exponential backoff on TRANSIENT
+        I/O errors (:func:`is_transient_save_error`), cleaning up the partial
+        save between attempts so a failed save never shadows the last good
+        checkpoint.
+
+        - ``drain=True`` additionally waits for the async commit INSIDE the
+          retry loop, so background write errors count as save failures too —
+          the emergency/final-save path uses this; periodic saves keep the
+          async overlap and surface commit errors at the next ``wait()``.
+        - ``deadline`` (a ``time.monotonic()`` instant) bounds the whole
+          attempt sequence — the SIGTERM grace window passes the moment the
+          preemption notice expires.  The first attempt always runs.
+
+        Non-transient errors re-raise immediately (after cleanup); exhausted
+        retries re-raise the LAST transient error."""
+        attempts = 1 + max(int(self.config.save_retries
+                               if retries is None else retries), 0)
+        delay = float(self.config.save_retry_backoff_seconds
+                      if backoff_seconds is None else backoff_seconds)
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                saved = self.save(state, metrics=metrics, force=force,
+                                  manifest=manifest)
+                if drain:
+                    self.wait()
+                return saved
+            except Exception as e:  # noqa: BLE001 — classified below
+                self._cleanup_failed_save(int(state.step))
+                if not is_transient_save_error(e):
+                    raise
+                last = e
+                remaining = attempts - 1 - attempt
+                if remaining == 0:
+                    break
+                if deadline is not None and time.monotonic() + delay >= deadline:
+                    logger.warning(
+                        "checkpoint save at step %d: grace deadline reached "
+                        "after attempt %d/%d", state.step, attempt + 1, attempts,
+                    )
+                    break
+                logger.warning(
+                    "checkpoint save at step %d failed transiently (%s: %s); "
+                    "retrying in %.2fs (%d attempt%s left)",
+                    state.step, type(e).__name__, e, delay, remaining,
+                    "s" if remaining != 1 else "",
+                )
+                time.sleep(delay)
+                delay *= 2.0
+        assert last is not None
+        raise last
+
+    def _cleanup_failed_save(self, step: int) -> None:
+        """Best-effort removal of a failed save's leftovers so the next
+        attempt (or the next run's auto-resume) sees only COMMITTED steps:
+        orbax writes into ``<step>.orbax-checkpoint-tmp-*`` staging dirs and
+        renames on commit, so stale staging dirs (plus an uncommitted final
+        ``<step>`` dir with no commit marker under an interrupted rename)
+        are the two shadows to clear.  ``latest_step`` ignores tmp dirs, but
+        a crashed retry loop must not leave the directory accumulating
+        half-written staging trees on a full disk.
+
+        The error a ``save()`` call surfaces may belong to a PREVIOUS step's
+        background commit (async saves report at the next manager call), so
+        the sweep drains the async manager first — after which no healthy
+        save can be in flight — and then clears EVERY stale staging dir, not
+        just the current step's."""
+        import shutil
+
+        try:
+            try:
+                self._mgr.wait_until_finished()
+            except Exception:  # noqa: BLE001 — the failure is already being
+                pass  # handled by the retry loop; the drain is for safety
+            # the directory property keeps epath for gs://-style stores —
+            # a plain Path() wrap would mangle the scheme and turn the
+            # remote sweep into a silent no-op
+            root = self.directory
+            if not root.exists():
+                return
+            for p in root.glob("*.orbax-checkpoint-tmp-*"):
+                if isinstance(p, Path):
+                    shutil.rmtree(p, ignore_errors=True)
+                else:
+                    try:
+                        p.rmtree()  # epath: remote store
+                    except Exception:  # noqa: BLE001 — best-effort sweep
+                        pass
+            # an interrupted save can leave the manager believing the step
+            # exists; drop it from the registry so the retry can re-save it
+            try:
+                if step in (self._mgr.all_steps() or []):
+                    final = root / str(step)
+                    if not final.exists():
+                        self._mgr.reload()
+            except Exception:  # noqa: BLE001 — registry probe is best-effort
+                pass
+        except Exception as e:  # noqa: BLE001 — cleanup must never mask the save error
+            logger.warning("partial-save cleanup at step %d failed: %s", step, e)
 
     def wait(self) -> None:
         """Block until any in-flight async save commits."""
@@ -219,6 +425,30 @@ class Checkpointer:
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def read_manifest(self, step: Optional[int] = None) -> Optional[dict]:
+        """The topology/plan manifest saved alongside ``step`` (newest when
+        ``None``), or ``None`` when the checkpoint predates manifests (or no
+        checkpoint exists).  Template-free: safe to call before any model
+        state exists — the restart-time replanner's first read."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        try:
+            out = self._mgr.restore(
+                step, args=ocp.args.Composite(manifest=ocp.args.JsonRestore())
+            )["manifest"]
+            return dict(out) if out is not None else None
+        except Exception as e:  # noqa: BLE001 — pre-elastic checkpoints have
+            # no manifest item, but a CORRUPT manifest or a transient remote
+            # read error must be distinguishable in the logs: a silent None
+            # here means "no replan", and the run would restore onto a stale
+            # declared mesh with an opaque shape crash
+            logger.warning(
+                "manifest read at step %s failed (%s: %s) — treating as "
+                "no-manifest; a pre-elastic checkpoint is expected here, "
+                "anything else deserves a look", step, type(e).__name__, e)
+            return None
 
     def restore(
         self,

@@ -416,6 +416,18 @@ def validate_config(cfg: ConfigDict) -> None:
 
         TelemetryConfig.from_config(em.get("telemetry"))
 
+    # ---- exp_manager.elastic ---------------------------------------------
+    # elastic-resume policy knobs (docs/elasticity.md): replan-on-resume,
+    # SIGTERM grace window, save retry/backoff.  ElasticConfig.from_config
+    # rejects unknown keys with a did-you-mean hint and ill-typed values —
+    # a typo'd grace_period must not silently run with the default
+    if isinstance(em, Mapping) and "elastic" in em:
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            ElasticConfig,
+        )
+
+        ElasticConfig.from_config(em.get("elastic"))
+
     # ---- model alignment --------------------------------------------------
     # root-level key (reference hf_llama3_8B_DPO_config.yaml:7); accepts a
     # bare string ("dpo") or a one-key block ({dpo: {beta: ...}})

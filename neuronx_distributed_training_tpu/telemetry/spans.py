@@ -28,7 +28,10 @@ from contextlib import contextmanager
 from typing import Iterator
 
 #: spans counted against goodput AND excluded from the throughput window
-NON_PRODUCTIVE_SPANS = ("compile", "validate", "checkpoint", "restart")
+#: ("replan" is the restart-time autotune re-plan on a changed world size —
+#: docs/elasticity.md)
+NON_PRODUCTIVE_SPANS = ("compile", "validate", "checkpoint", "restart",
+                        "replan")
 
 
 class SpanTimer:
@@ -61,6 +64,16 @@ class SpanTimer:
         self._cumulative[name] = self._cumulative.get(name, 0.0) + seconds
         if name in self.non_productive:
             self._excluded_since_take += seconds
+
+    def add_preexisting(self, name: str, seconds: float) -> None:
+        """Account wall time spent BEFORE this timer existed (the CLI's
+        restart-time replan runs before ``fit()`` constructs the timer):
+        the span is added AND the wall-clock origin moves back by the same
+        amount, so ``goodput_fraction`` keeps ``nonproductive <= wall``."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        self._t_start -= seconds
+        self.add(name, seconds)
 
     # -- per-boundary window -------------------------------------------------
 

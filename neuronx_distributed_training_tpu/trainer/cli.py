@@ -155,10 +155,47 @@ def main() -> None:
 
     cfg = load_config(args.config, overrides)
 
+    # -- elastic replan-on-resume (docs/elasticity.md): if a resumable
+    # checkpoint's manifest names a different world size than the live fleet,
+    # re-run the autotune planner on the NEW world size (filtered to
+    # checkpoint-layout-compatible plans) and impose the winner BEFORE
+    # anything materializes.  Runs before --autotune: a replan IS the plan
+    # for this incarnation.
+    replan = None
+    from neuronx_distributed_training_tpu.trainer.elastic import (
+        ElasticConfig,
+        ElasticResumeError,
+        maybe_replan,
+    )
+
+    elastic_cfg = ElasticConfig.from_config(
+        dict(cfg.get("exp_manager", {}) or {}).get("elastic"))
+    if elastic_cfg.enabled:
+        try:
+            replan = maybe_replan(cfg, len(jax.devices()), elastic=elastic_cfg)
+        except ElasticResumeError as e:
+            # curated operator-facing refusal (the message carries the --set
+            # remediation) — a clean one-line exit, not a traceback
+            raise SystemExit(f"elastic resume refused: {e}") from e
+        if replan.replanned:
+            cfg = replan.cfg
+            logger.warning(
+                "elastic replan imposed for %d chips (was %d): see "
+                "run_summary.json elastic section",
+                replan.record["new_world"], replan.record["old_world"],
+            )
+
     # -- autotune: plan BEFORE materializing (no params, no data yet) ------
     plan_report = None
     at_block = dict(cfg.get("autotune", {}) or {})
-    if args.autotune is not None or at_block.get("enabled"):
+    if replan is not None and replan.replanned:
+        # the replanner already planned this world size against the
+        # checkpoint's layout constraints; a second, layout-blind autotune
+        # pass could impose an un-resumable mesh on top of it
+        if args.autotune is not None or at_block.get("enabled"):
+            logger.info("autotune skipped: elastic replan already planned "
+                        "this restart")
+    elif args.autotune is not None or at_block.get("enabled"):
         from neuronx_distributed_training_tpu.autotune import plan_config
 
         top_k = (args.autotune if (args.autotune or 0) > 0
@@ -179,6 +216,31 @@ def main() -> None:
                 f"autotune: no surviving plan for {chips} chips"
                 + (f" ({plan_report.error})" if plan_report.error else "")
             )
+        if replan is not None and replan.manifest is not None:
+            # a resumable checkpoint binds this launch even at the SAME
+            # world size: a layout-blind winner could impose an
+            # un-resumable mesh — take the best layout-compatible candidate
+            from neuronx_distributed_training_tpu.trainer.elastic import (
+                plan_layout_reason,
+            )
+
+            compatible = next(
+                (c for c in plan_report.candidates
+                 if not c.discarded
+                 and plan_layout_reason(replan.manifest, c.plan) is None),
+                None)
+            if compatible is None:
+                raise SystemExit(
+                    "autotune: no candidate keeps the resumable "
+                    "checkpoint's layer layout — drop --autotune to resume "
+                    "with the declared mesh, or start fresh with "
+                    "exp_manager.resume_if_exists=false")
+            if compatible is not winner:
+                logger.warning(
+                    "autotune: top plan is incompatible with the resumable "
+                    "checkpoint's layer layout; imposing %s instead",
+                    compatible.plan.describe())
+            winner = compatible
         logger.info("autotune: imposing %s", winner.plan.describe())
         cfg = load_config(
             args.config,
@@ -186,10 +248,15 @@ def main() -> None:
         )
 
     trainer = Trainer.from_config(cfg, enable_checkpointing=not args.compile_only)
+    if replan is not None and replan.replanned:
+        # fit() accounts the replan wall time as a goodput span and persists
+        # the old-plan -> new-plan record in run_summary.json's elastic
+        # section at teardown
+        trainer.replan_record = replan.record
     if plan_report is not None:
         # the chosen plan becomes a static run fact: the compile census
         # carries it, and run_summary.json gets the full ranked report
-        trainer.run_facts["autotune_plan"] = plan_report.winner.plan.describe()
+        trainer.run_facts["autotune_plan"] = winner.plan.describe()
         trainer.exp.write_run_summary({"autotune": plan_report.to_dict()})
 
     if args.compile_only:

@@ -1,0 +1,529 @@
+"""Elastic resume: world-size-agnostic checkpoints + restart-time replanning.
+
+On real fleets chips disappear mid-run — preemption, ICI link flaps, host
+failures.  This module closes the halt→resume loop (ROADMAP item 5) so a run
+survives chip-count changes end to end:
+
+- every checkpoint carries a **topology/plan manifest** (:func:`build_manifest`
+  → ``Checkpointer.save(manifest=...)``): world size, mesh axes, the resolved
+  parallelism plan, and the model identity — readable WITHOUT templates, so a
+  restart can reason about the save before any model state exists;
+- :func:`maybe_replan` detects that the live chip count differs from the
+  manifest's world size and re-runs the autotune planner
+  (:func:`~neuronx_distributed_training_tpu.autotune.plan_config`) on the NEW
+  world size, filtered to plans whose parameter-tree layout matches the
+  checkpoint (:func:`plan_layout_reason` — pipeline ``pp``/``vp`` pin the
+  stacked-layer layout; tp/cp/ep/dp only reshard the same global arrays, so
+  they are free to change).  The chosen plan is imposed on the config and the
+  old-plan→new-plan record lands in ``run_summary.json``;
+- :class:`FaultInjector` + ``tools/elastic_drill.py`` provide the preemption
+  drill harness: kill or shrink a run at a configurable step (mid-step,
+  mid-save, mid-restore) and prove loss-trajectory continuity after resume at
+  the same or a different dp degree.
+
+The knob block (validated at config load with did-you-mean hints):
+
+.. code-block:: yaml
+
+    exp_manager:
+      elastic:
+        enabled: true                    # replan-on-resume at nxdt-train start
+        grace_period_seconds: 30.0       # SIGTERM → emergency-save budget
+        save_retries: 3                  # transient-I/O retry (ENOSPC/EIO)
+        save_retry_backoff_seconds: 0.5  # doubled per attempt
+        replan_top_k: 5
+        replan_audit: false              # true: AOT-audit candidates (slower)
+
+See docs/elasticity.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+logger = logging.getLogger(__name__)
+
+#: manifest schema version (bump on breaking layout changes)
+MANIFEST_FORMAT = 1
+
+#: knob name -> (default, type) — the single source of truth the validator,
+#: ``from_config``, and docs/elasticity.md share
+ELASTIC_KNOBS: dict[str, Any] = {
+    "enabled": False,
+    "grace_period_seconds": 30.0,
+    "save_retries": 3,
+    "save_retry_backoff_seconds": 0.5,
+    "replan_top_k": 5,
+    "replan_audit": False,
+}
+
+
+class ElasticResumeError(RuntimeError):
+    """A resume that cannot proceed: the checkpoint's layout and the live
+    world admit no legal plan (or the model identity changed)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """``exp_manager.elastic`` — elastic-resume policy knobs."""
+
+    enabled: bool = False
+    grace_period_seconds: float = 30.0
+    save_retries: int = 3
+    save_retry_backoff_seconds: float = 0.5
+    replan_top_k: int = 5
+    replan_audit: bool = False
+
+    @classmethod
+    def from_config(cls, block: Any) -> "ElasticConfig":
+        """Parse (and validate) an ``exp_manager.elastic`` block.  Accepts
+        ``None``/``{}`` (defaults) or a mapping; a bare bool toggles
+        ``enabled``.  Unknown keys and ill-typed values raise ``ValueError``
+        with a did-you-mean hint — a typo'd knob must not silently run with
+        defaults."""
+        if block is None:
+            return cls()
+        if isinstance(block, bool):
+            return cls(enabled=block)
+        if not isinstance(block, Mapping):
+            raise ValueError(
+                f"exp_manager.elastic must be a mapping of "
+                f"{sorted(ELASTIC_KNOBS)} (or a single bool), got "
+                f"{type(block).__name__}"
+            )
+        unknown = set(block) - set(ELASTIC_KNOBS)
+        if unknown:
+            from neuronx_distributed_training_tpu.config.loader import (
+                did_you_mean,
+            )
+
+            raise ValueError(
+                f"unknown exp_manager.elastic keys {sorted(unknown)}; "
+                f"supported: {sorted(ELASTIC_KNOBS)}"
+                + did_you_mean(unknown, ELASTIC_KNOBS)
+            )
+        values: dict[str, Any] = {}
+        for k, v in block.items():
+            default = ELASTIC_KNOBS[k]
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(
+                        f"exp_manager.elastic.{k} must be a boolean, got {v!r}"
+                    )
+                values[k] = v
+            elif isinstance(default, int):
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ValueError(
+                        f"exp_manager.elastic.{k} must be an integer, "
+                        f"got {v!r}"
+                    )
+                values[k] = int(v)
+                if values[k] < 0:
+                    raise ValueError(
+                        f"exp_manager.elastic.{k} must be >= 0, got {v!r}"
+                    )
+            else:
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"exp_manager.elastic.{k} must be a number, got {v!r}"
+                    )
+                values[k] = float(v)
+                if values[k] < 0.0:
+                    raise ValueError(
+                        f"exp_manager.elastic.{k} must be >= 0, got {v!r}"
+                    )
+        ec = cls(**values)
+        if ec.replan_top_k < 1:
+            raise ValueError(
+                f"exp_manager.elastic.replan_top_k must be >= 1, got "
+                f"{ec.replan_top_k}"
+            )
+        return ec
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def build_manifest(cfg: Mapping, mesh: Any, *, step: int,
+                   schedule: Optional[str], model_family: str,
+                   save_bf16: bool) -> dict[str, Any]:
+    """The world-size-agnostic topology/plan manifest saved with every
+    checkpoint.  Everything a cold restart needs to decide whether (and how)
+    the save fits the live fleet — no arrays, no templates."""
+    from neuronx_distributed_training_tpu.config.loader import batch_schedule
+
+    ds = dict(cfg.get("distributed_strategy", {}) or {})
+    data = dict(cfg.get("data", {}) or {})
+    model = dict(cfg.get("model", {}) or {})
+    world = int(mesh.devices.size)
+    sched = batch_schedule(cfg, world)
+    pp = int(ds.get("pipeline_model_parallel_size", 1) or 1)
+    vp = int(ds.get("virtual_pipeline_model_parallel_size") or 1)
+    remat = model.get("activations_checkpoint_granularity", "selective")
+    return {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "world_size": world,
+        "mesh_axes": {str(k): int(v) for k, v in dict(mesh.shape).items()},
+        "plan": {
+            "tp": int(ds.get("tensor_model_parallel_size", 1) or 1),
+            "pp": pp,
+            "cp": int(ds.get("context_parallel_size", 1) or 1),
+            "ep": int(ds.get("expert_model_parallel_size", 1) or 1),
+            "vp": vp,
+            "dp": int(sched["dp_size"]),
+            "micro_batch_size": int(sched["micro_batch_size"]),
+            "num_microbatches": int(sched["num_microbatches"]),
+            "remat": str(remat) if remat else "none",
+            "schedule": schedule or "none",
+        },
+        "model": {
+            "family": model_family,
+            "num_layers": int(model.get("num_layers", 0) or 0),
+            "hidden_size": int(model.get("hidden_size", 0) or 0),
+            "vocab_size": int(model.get("vocab_size", 0) or 0),
+        },
+        "data": {
+            "global_batch_size": int(sched["global_batch_size"]),
+            "seq_length": int(data.get("seq_length", 0) or 0),
+        },
+        "zero1": bool(ds.get("zero1", True)),
+        "save_bf16": bool(save_bf16),
+        "layer_layout": "interleaved" if pp > 1 and vp > 1 else "flat",
+    }
+
+
+def discover_checkpoint_dir(cfg: Mapping) -> Optional[Path]:
+    """The checkpoint dir a restart would resume from, WITHOUT building an
+    :class:`~neuronx_distributed_training_tpu.trainer.exp_manager.ExpManager`
+    (which creates directories).  This mirrors ``ExpManager``'s selection
+    EXACTLY — ``resume_if_exists`` on, newest ``version_N`` (digit-suffixed
+    only, same parse as ``exp_manager.py``), no fallback to older versions —
+    because a replan keyed to a checkpoint the trainer will never restore
+    would constrain a fresh run with a stale layout.  ``None`` when the
+    restart would not resume anything."""
+    from neuronx_distributed_training_tpu.trainer.exp_manager import (
+        experiment_base_dir,
+        latest_version,
+    )
+
+    em = dict(cfg.get("exp_manager", {}) or {})
+    if not bool(em.get("resume_if_exists", False)):
+        # ExpManager will open a FRESH version dir and restore nothing —
+        # whatever checkpoints older versions hold do not bind this launch
+        return None
+    base = experiment_base_dir(dict(cfg))
+    v = latest_version(base)
+    if v is None:
+        return None
+    ck = base / f"version_{v}" / "checkpoints"
+    return ck if ck.exists() else None
+
+
+def read_latest_manifest(checkpoint_dir: Path) -> Optional[dict]:
+    """Newest checkpoint's manifest under ``checkpoint_dir`` (None when no
+    checkpoint, no manifest item, or orbax unavailable)."""
+    try:
+        from neuronx_distributed_training_tpu.checkpoint import (
+            CheckpointConfig,
+            Checkpointer,
+        )
+
+        ck = Checkpointer(
+            CheckpointConfig(dir=str(checkpoint_dir), save_top_k=0,
+                             async_save=False))
+        try:
+            return ck.read_manifest()
+        finally:
+            ck.close()
+    except Exception as e:  # noqa: BLE001 — discovery must never kill a launch
+        logger.warning("manifest discovery under %s failed: %s",
+                       checkpoint_dir, e)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# replanning
+# ---------------------------------------------------------------------------
+
+
+def plan_layout_reason(manifest: Mapping, plan: Any) -> Optional[str]:
+    """Why ``plan`` (an ``autotune.space.Plan`` or plan-shaped mapping) is
+    INCOMPATIBLE with the checkpoint described by ``manifest`` — or ``None``
+    when the restored tree reshards onto it cleanly.
+
+    The parameter tree's GLOBAL shapes are what restore validates against:
+    ``pp``/``vp`` change the stacked-layer layout (``[L]`` vs
+    ``[vp, pp, Lc]`` leading dims), so both must match the save.  tp/cp/ep/dp
+    and microbatching only reshard or re-chunk the same global arrays — free
+    to change."""
+    old = dict(manifest.get("plan", {}) or {})
+    get = (plan.get if isinstance(plan, Mapping)
+           else lambda k, d=None: getattr(plan, k, d))
+    pp_old, vp_old = int(old.get("pp", 1)), int(old.get("vp", 1))
+    pp_new, vp_new = int(get("pp", 1) or 1), int(get("vp", 1) or 1)
+    if pp_new != pp_old:
+        return (f"pipeline_model_parallel_size {pp_old} -> {pp_new}: the "
+                f"layer stack was saved sliced into {pp_old} stages")
+    if vp_new != vp_old and (pp_old > 1 or pp_new > 1):
+        return (f"virtual_pipeline_model_parallel_size {vp_old} -> {vp_new}: "
+                f"the checkpoint's layer layout is "
+                f"{manifest.get('layer_layout', 'flat')}")
+    return None
+
+
+@dataclasses.dataclass
+class ReplanResult:
+    """What :func:`maybe_replan` decided.  ``record`` is ``None`` when no
+    replanning happened (no checkpoint, no manifest, or the world matches)."""
+
+    cfg: Any
+    record: Optional[dict] = None
+    manifest: Optional[dict] = None
+    checkpoint_dir: Optional[Path] = None
+
+    @property
+    def replanned(self) -> bool:
+        return self.record is not None
+
+
+def maybe_replan(cfg: Any, chips: int, *,
+                 elastic: Optional[ElasticConfig] = None,
+                 force: bool = False) -> ReplanResult:
+    """The restart-time replanning entry (``nxdt-train`` start, drill
+    harness): if a resumable checkpoint's manifest names a DIFFERENT world
+    size than ``chips``, re-run the autotune planner on the new world,
+    filtered to checkpoint-layout-compatible plans, and return the config
+    with the winner imposed plus the old-plan→new-plan record.
+
+    Raises :class:`ElasticResumeError` when the checkpoint cannot legally
+    resume on this fleet (model identity changed, or no layout-compatible
+    plan exists) — a curated error beats an opaque restore-shape crash."""
+    if elastic is None:
+        elastic = ElasticConfig.from_config(
+            dict(cfg.get("exp_manager", {}) or {}).get("elastic"))
+    ck_dir = discover_checkpoint_dir(cfg)
+    if ck_dir is None:
+        return ReplanResult(cfg=cfg)
+    manifest = read_latest_manifest(ck_dir)
+    if manifest is None:
+        return ReplanResult(cfg=cfg, checkpoint_dir=ck_dir)
+    old_world = int(manifest.get("world_size", 0) or 0)
+    if old_world == int(chips) and not force:
+        return ReplanResult(cfg=cfg, manifest=manifest, checkpoint_dir=ck_dir)
+
+    # model identity: a different model cannot "resume", replan or not
+    from neuronx_distributed_training_tpu.autotune import plan_config
+
+    mf = dict(manifest.get("model", {}) or {})
+    model = dict(cfg.get("model", {}) or {})
+    for key, cfg_key in (("num_layers", "num_layers"),
+                         ("hidden_size", "hidden_size"),
+                         ("vocab_size", "vocab_size")):
+        want = int(mf.get(key, 0) or 0)
+        have = int(model.get(cfg_key, 0) or 0)
+        if want and have and want != have:
+            raise ElasticResumeError(
+                f"checkpoint at {ck_dir} was saved with model.{key}={want} "
+                f"but this config declares {have}: not the same model — "
+                f"resume refused"
+            )
+
+    t0 = time.perf_counter()
+    report = plan_config(
+        cfg, chips=int(chips), top_k=elastic.replan_top_k,
+        audit=elastic.replan_audit, max_devices=min(8, int(chips)),
+    )
+    if report.error:
+        raise ElasticResumeError(
+            f"replan for {chips} chips failed: {report.error}"
+        )
+    chosen = None
+    skipped: list[str] = []
+    for cand in report.candidates:
+        if cand.discarded:
+            continue
+        reason = plan_layout_reason(manifest, cand.plan)
+        if reason is None:
+            chosen = cand
+            break
+        skipped.append(f"{cand.plan.describe()}: {reason}")
+    if chosen is None and report.n_plans > len(report.candidates):
+        # the ranked top-k had no layout match — walk the FULL lattice
+        # (analytic-only; a layout-compatible plan deep in the ranking still
+        # beats refusing to resume)
+        full = plan_config(cfg, chips=int(chips), top_k=report.n_plans,
+                           audit=False)
+        for cand in full.candidates:
+            if not cand.discarded and plan_layout_reason(
+                    manifest, cand.plan) is None:
+                chosen = cand
+                report = full
+                break
+    if chosen is None:
+        # the lattice is curated, not exhaustive (e.g. vp candidates are a
+        # fixed set, so a pp=14 vp=3 save has no lattice representation):
+        # before refusing, accept the config's OWN declared parallelism when
+        # it is legal on the new world and keeps the checkpoint layout —
+        # this is also what makes the error's --set remediation actionable
+        # (a hand-forced mesh re-enters this function first)
+        fb = _declared_plan_fallback(cfg, manifest, int(chips))
+        if fb is not None:
+            dt = time.perf_counter() - t0
+            record = {
+                "old_world": old_world,
+                "new_world": int(chips),
+                "checkpoint_step": manifest.get("step"),
+                "old_plan": dict(manifest.get("plan", {}) or {}),
+                "new_plan": fb,
+                "fallback": "declared-config",
+                "replan_seconds": round(dt, 3),
+                "skipped_incompatible": len(skipped),
+            }
+            logger.warning(
+                "elastic replan: no lattice plan keeps the checkpoint's "
+                "layer layout; keeping the config's declared parallelism "
+                "%s on %d chips", _plan_str(fb), chips,
+            )
+            return ReplanResult(cfg=cfg, record=record, manifest=manifest,
+                                checkpoint_dir=ck_dir)
+        old_plan = dict(manifest.get("plan", {}) or {})
+        raise ElasticResumeError(
+            f"no plan for {chips} chips keeps the checkpoint's layer layout "
+            f"(pp={old_plan.get('pp')}, "
+            f"vp={old_plan.get('vp')}); candidates rejected: "
+            + ("; ".join(skipped) if skipped else "none enumerated")
+            + " — relaunch on a chip count that admits this layout, or "
+              "force a compatible mesh by hand (--set distributed_strategy."
+              "pipeline_model_parallel_size=... etc.)"
+        )
+    from neuronx_distributed_training_tpu.config.loader import load_config
+
+    new_cfg = load_config(cfg, chosen.plan.overrides(report.facts))
+    dt = time.perf_counter() - t0
+    record = {
+        "old_world": old_world,
+        "new_world": int(chips),
+        "checkpoint_step": manifest.get("step"),
+        "old_plan": dict(manifest.get("plan", {}) or {}),
+        "new_plan": dataclasses.asdict(chosen.plan),
+        "predicted_step_seconds": round(chosen.estimate.step_seconds, 6),
+        "replan_seconds": round(dt, 3),
+        "skipped_incompatible": len(skipped),
+    }
+    logger.warning(
+        "elastic replan: world %d -> %d chips; %s -> %s (%.1fs, "
+        "%d layout-incompatible candidates skipped)",
+        old_world, chips, _plan_str(record["old_plan"]),
+        chosen.plan.describe(), dt, len(skipped),
+    )
+    return ReplanResult(cfg=new_cfg, record=record, manifest=manifest,
+                        checkpoint_dir=ck_dir)
+
+
+def _declared_plan_fallback(cfg: Any, manifest: Mapping,
+                            chips: int) -> Optional[dict]:
+    """The config's own declared parallelism as a replan candidate: legal on
+    ``chips`` (``batch_schedule`` validates the mesh/batch arithmetic) and
+    layout-compatible with the checkpoint.  The escape hatch for layouts the
+    curated plan lattice cannot express.  ``None`` when the declared plan
+    does not fit the new world or the saved layout."""
+    from neuronx_distributed_training_tpu.config.loader import batch_schedule
+
+    ds = dict(cfg.get("distributed_strategy", {}) or {})
+    tp = int(ds.get("tensor_model_parallel_size", 1) or 1)
+    pp = int(ds.get("pipeline_model_parallel_size", 1) or 1)
+    cp = int(ds.get("context_parallel_size", 1) or 1)
+    if int(chips) % (tp * pp * cp) != 0:
+        # batch_schedule floors dp — an inexact fit would silently idle chips
+        return None
+    try:
+        sched = batch_schedule(cfg, int(chips))
+    except Exception:  # noqa: BLE001 — an unfit declared plan is just "no"
+        return None
+    plan = {
+        "tp": tp,
+        "pp": pp,
+        "cp": cp,
+        "ep": int(ds.get("expert_model_parallel_size", 1) or 1),
+        "vp": int(ds.get("virtual_pipeline_model_parallel_size") or 1),
+        "dp": int(sched["dp_size"]),
+        "micro_batch_size": int(sched["micro_batch_size"]),
+        "num_microbatches": int(sched["num_microbatches"]),
+    }
+    if plan_layout_reason(manifest, plan) is not None:
+        return None
+    return plan
+
+
+def _plan_str(plan: Mapping) -> str:
+    # tools/metrics_report.py carries a deliberate stdlib-only copy of this
+    # formatter — keep the two in sync when the plan record grows a key
+    keys = ("dp", "tp", "pp", "cp", "ep", "vp")
+    parts = [f"{k}={plan[k]}" for k in keys if plan.get(k) is not None]
+    if plan.get("micro_batch_size") is not None:
+        parts.append(f"mbs={plan['micro_batch_size']}")
+    if plan.get("schedule") not in (None, "none"):
+        parts.append(f"sched={plan['schedule']}")
+    return " ".join(parts) or "?"
+
+
+# ---------------------------------------------------------------------------
+# fault injection (the drill harness's kill switch)
+# ---------------------------------------------------------------------------
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by :class:`FaultInjector` in ``kill`` mode — stands in for the
+    process dying (SIGKILL/power loss) at a chosen point.  The drill harness
+    catches it where a real fleet would observe the process gone."""
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Kills (or gracefully preempts) a run at a configurable point.
+
+    Attach to a trainer (``trainer.fault_injector = FaultInjector(...)``);
+    the fit loop and checkpoint paths call :meth:`maybe_fire` at their
+    injection points:
+
+    - ``phase="step"``    just before the train step at ``at_step`` runs;
+    - ``phase="save"``    right after a checkpoint save is INITIATED (an
+      async save is in flight when the fault hits — the drain-on-teardown
+      contract is what keeps it from being orphaned);
+    - ``phase="restore"`` mid-restore, after the checkpoint was read but
+      before any state was applied (the save must survive untouched).
+
+    ``mode="kill"`` raises :class:`SimulatedPreemption`; ``mode="sigterm"``
+    returns True once so the caller requests the graceful-stop path (the
+    grace-window emergency checkpoint).
+    """
+
+    at_step: int
+    mode: str = "kill"          # kill | sigterm
+    phase: str = "step"         # step | save | restore
+    fired: bool = False
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("kill", "sigterm"):
+            raise ValueError(f"FaultInjector.mode must be kill|sigterm, "
+                             f"got {self.mode!r}")
+        if self.phase not in ("step", "save", "restore"):
+            raise ValueError(f"FaultInjector.phase must be step|save|restore, "
+                             f"got {self.phase!r}")
+
+    def maybe_fire(self, phase: str, step: int) -> bool:
+        """Called at each injection point; fires at most once."""
+        if self.fired or phase != self.phase or int(step) < self.at_step:
+            return False
+        self.fired = True
+        if self.mode == "kill":
+            raise SimulatedPreemption(
+                f"injected {self.phase} kill at step {step}")
+        return True

@@ -22,6 +22,53 @@ from neuronx_distributed_training_tpu.utils.perf import Throughput, mfu as _mfu
 logger = logging.getLogger(__name__)
 
 
+def _exp_base_path(exp_dir, name):
+    """``<exp-root>/<name>`` with remote-store URIs (``gs://`` etc.) routed
+    through epath — ``Path()`` would mangle the scheme into a local dir
+    literally named ``gs:``."""
+    if "://" in str(exp_dir):
+        from etils import epath
+
+        return epath.Path(str(exp_dir)) / str(name)
+    return Path(str(exp_dir)) / str(name)
+
+
+def exp_root_and_name(cfg: dict) -> tuple:
+    """``(exp-root, name)`` for a config — THE key-fallback chain
+    (``explicit_log_dir`` → ``exp_dir`` → default, ``name`` from the block or
+    the config root), shared by :meth:`ExpManager.from_config`, the elastic
+    replanner's checkpoint discovery (``trainer/elastic.py``), and the drill
+    harness (``tools/elastic_drill.py``) so all of them resolve the directory
+    ``ExpManager`` will actually open."""
+    em = dict(cfg.get("exp_manager", {}) or {})
+    return (
+        em.get("explicit_log_dir") or em.get("exp_dir") or "nxdt_experiments",
+        em.get("name", cfg.get("name", "default")),
+    )
+
+
+def experiment_base_dir(cfg: dict) -> Any:
+    """``<exp-root>/<name>`` for a config (see :func:`exp_root_and_name`)."""
+    return _exp_base_path(*exp_root_and_name(cfg))
+
+
+def latest_version(base) -> Optional[int]:
+    """Newest ``version_N`` index under ``base`` (digit-suffixed dirs only,
+    an operator's ``version_backup_2`` is ignored) — THE version-dir parse,
+    shared by :class:`ExpManager`, the elastic replanner's checkpoint
+    discovery (``trainer/elastic.py``), and the drill harness
+    (``tools/elastic_drill.py``), so all three always select the same
+    directory.  ``None`` when no versions exist."""
+    if not base.exists():
+        return None
+    versions = sorted(
+        int(p.name.split("_")[1])
+        for p in base.glob("version_*")
+        if p.name.split("_")[1].isdigit()
+    )
+    return versions[-1] if versions else None
+
+
 class ExpManager:
     """Owns the experiment directory and metric writers."""
 
@@ -47,22 +94,11 @@ class ExpManager:
         seq_len: int = 0,
         telemetry: Optional[TelemetryConfig] = None,
     ):
-        if "://" in str(exp_dir):
-            # remote store (gs:// etc.): epath keeps the scheme — Path()
-            # would mangle it into a local directory literally named "gs:"
-            from etils import epath
-
-            base = epath.Path(exp_dir) / name
-        else:
-            base = Path(exp_dir) / name
+        base = _exp_base_path(exp_dir, name)
         if version is None:
             if resume_if_exists and base.exists():
-                versions = sorted(
-                    int(p.name.split("_")[1])
-                    for p in base.glob("version_*")
-                    if p.name.split("_")[1].isdigit()
-                )
-                version = f"version_{versions[-1]}" if versions else "version_0"
+                v = latest_version(base)
+                version = f"version_{v}" if v is not None else "version_0"
             else:
                 n = 0
                 while (base / f"version_{n}").exists():
@@ -178,9 +214,10 @@ class ExpManager:
         """Build from the reference's ``exp_manager:`` block
         (``config_overview.rst:200-249``)."""
         em = dict(cfg.get("exp_manager", {}) or {})
+        exp_dir, name = exp_root_and_name(cfg)
         return cls(
-            exp_dir=em.get("explicit_log_dir") or em.get("exp_dir") or "nxdt_experiments",
-            name=em.get("name", cfg.get("name", "default")),
+            exp_dir=exp_dir,
+            name=name,
             create_tensorboard_logger=bool(em.get("create_tensorboard_logger", True)),
             log_every_n_steps=int(
                 (cfg.get("trainer", {}) or {}).get("log_every_n_steps", 10)

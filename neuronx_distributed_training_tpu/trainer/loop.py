@@ -237,6 +237,21 @@ class Trainer:
     # donation mode the jitted step was built with (StepProgram.donate) —
     # the in-loop graph audit checks the SAME donated set, not a re-derived one
     donate: Any = True
+    # elastic-resume policy (trainer.elastic.ElasticConfig; parsed from
+    # exp_manager.elastic): SIGTERM grace window, save retry, replan knobs
+    elastic: Optional[Any] = None
+    # restart-time replan record (trainer.elastic.maybe_replan) — set by the
+    # CLI / drill harness when the live world size differed from the
+    # checkpoint manifest; fit() accounts its wall time as a "replan" span
+    # and persists it in run_summary.json's elastic section
+    replan_record: Optional[dict] = None
+    # preemption drill hook (trainer.elastic.FaultInjector): fires at the
+    # step/save/restore injection points; None outside drills
+    fault_injector: Optional[Any] = None
+    # sigterm-mode injection at the save/restore points happens outside the
+    # fit loop's scope, so those call sites park the notice here and the loop
+    # top converts it into a graceful-stop request (same path as SIGTERM)
+    preemption_notice: Optional[str] = None
 
     # -- assembly -----------------------------------------------------------
 
@@ -842,6 +857,13 @@ class Trainer:
             ck_cfg = dataclasses.replace(ck_cfg, dir=exp.checkpoint_dir)
             checkpointer = Checkpointer(ck_cfg)
 
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            ElasticConfig,
+        )
+
+        elastic = ElasticConfig.from_config(
+            (cfg.get("exp_manager", {}) or {}).get("elastic"))
+
         pre_fit = None
         if alignment in ("dpo", "kto"):
             if alignment == "dpo":
@@ -1011,7 +1033,7 @@ class Trainer:
             val_data_module=val_data_module, exp=exp, checkpointer=checkpointer,
             max_steps=max_steps, pre_fit=pre_fit, ema_cfg=ema_cfg,
             pipeline_schedule=pp_schedule, run_facts=run_facts,
-            donate=asm.donate,
+            donate=asm.donate, elastic=elastic,
         )
 
     # -- resume -------------------------------------------------------------
@@ -1073,6 +1095,14 @@ class Trainer:
                 "without the health subtree, counters start fresh at step %d",
                 int(state.step),
             )
+        if self.fault_injector is not None:
+            # drill injection point "restore": the checkpoint has been read
+            # but nothing applied yet — a kill here must leave the save
+            # intact and the next resume able to start over; sigterm mode is
+            # a preemption notice landing mid-restore
+            if self.fault_injector.maybe_fire("restore", int(state.step)):
+                self.preemption_notice = (
+                    "injected preemption notice (mid-restore)")
         self.params = state.params
         self.opt_state = state.opt_state
         self.step = state.step
@@ -1138,11 +1168,25 @@ class Trainer:
         # preemption hook: SIGTERM (SLURM preemption / spot reclaim) requests a
         # graceful stop — checkpoint at the next step boundary, then exit clean
         # so resume_if_exists continues the run (reference: Lightning's
-        # preemption plugin + SLURM requeue, train_setup.sh:28-29)
-        stop_requested = {"reason": None}
+        # preemption plugin + SLURM requeue, train_setup.sh:28-29).  The
+        # elastic grace window starts at the NOTICE, not at the boundary: the
+        # emergency save's retry loop must give up before the fleet kills the
+        # process (docs/elasticity.md "Grace window").
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            ElasticConfig,
+        )
+
+        el = self.elastic if self.elastic is not None else ElasticConfig()
+        stop_requested: dict[str, Any] = {"reason": None, "deadline": None}
+
+        def _request_stop(reason: str) -> None:
+            stop_requested["reason"] = reason
+            if stop_requested["deadline"] is None and el.grace_period_seconds > 0:
+                stop_requested["deadline"] = (
+                    _time.monotonic() + el.grace_period_seconds)
 
         def _on_sigterm(signum, frame):
-            stop_requested["reason"] = "SIGTERM (preemption)"
+            _request_stop("SIGTERM (preemption)")
 
         old_handler = None
         try:
@@ -1150,29 +1194,49 @@ class Trainer:
         except ValueError:
             pass  # not in the main thread (tests); preemption hook disabled
 
-        # pre_fit BEFORE resume: the DPO reference pass must see the frozen
-        # initial policy, not resumed weights (see pre_fit docstring).  Both
-        # are "restart" time for goodput: work a run repeats after preemption
-        # that trains nothing.
-        with spans.span("restart"):
-            if self.pre_fit is not None:
-                self.pre_fit(self)
-            resumed = self.maybe_resume()
-            if resumed and monitor is not None and "health" in self.opt_state:
-                # align the boundary comparator with the RESTORED cumulative
-                # counter — otherwise the first boundary re-triggers the
-                # policy for an anomaly the previous incarnation handled
-                # (a permanent halt/restart loop under policy=halt)
-                monitor.seed_counters(
-                    int(self.opt_state["health"]["nonfinite_count"]))
+        resumed = False
         last_metrics: dict[str, float] = {}
-        # background prefetch: slow fetch_rows (arrow page-in, mmap faults)
-        # must not stall dispatch (the reference's MpDeviceLoader role);
-        # shard_batch uses an explicit NamedSharding, so it is thread-safe
-        batches = PrefetchIterator(self.data_module.sharded_batches(self.mesh))
-        log_every = max(1, int(self.exp.log_every_n_steps))
-        census_pending = tel.compile_census
+        batches = None
         try:
+            # the restart phase runs INSIDE the teardown scope: a restore
+            # failure (corrupt checkpoint, drill restore-kill) must still
+            # restore the SIGTERM handler, write the teardown summaries, and
+            # close the exp manager — otherwise every faulted incarnation
+            # leaks its log FileHandler and leaves a dead trainer's stop
+            # closure bound to SIGTERM
+            # restart-time replan (trainer.elastic.maybe_replan ran in the
+            # CLI / drill harness BEFORE this trainer existed): account its
+            # wall time as the "replan" span so goodput sees the full
+            # restart cost
+            if self.replan_record:
+                spans.add_preexisting(
+                    "replan",
+                    float(self.replan_record.get("replan_seconds", 0.0) or 0.0))
+            # pre_fit BEFORE resume: the DPO reference pass must see the
+            # frozen initial policy, not resumed weights (see pre_fit
+            # docstring).  Both are "restart" time for goodput: work a run
+            # repeats after preemption that trains nothing.
+            with spans.span("restart"):
+                if self.pre_fit is not None:
+                    self.pre_fit(self)
+                resumed = self.maybe_resume()
+                if resumed and monitor is not None and "health" in self.opt_state:
+                    # align the boundary comparator with the RESTORED
+                    # cumulative counter — otherwise the first boundary
+                    # re-triggers the policy for an anomaly the previous
+                    # incarnation handled (a permanent halt/restart loop
+                    # under policy=halt)
+                    monitor.seed_counters(
+                        int(self.opt_state["health"]["nonfinite_count"]))
+            # background prefetch: slow fetch_rows (arrow page-in, mmap
+            # faults) must not stall dispatch (the reference's MpDeviceLoader
+            # role); shard_batch uses an explicit NamedSharding, so it is
+            # thread-safe.  AFTER resume: the sampler's consumed_samples
+            # must be restored before the first fetch.
+            batches = PrefetchIterator(
+                self.data_module.sharded_batches(self.mesh))
+            log_every = max(1, int(self.exp.log_every_n_steps))
+            census_pending = tel.compile_census
             with self.mesh, shd.use_mesh(self.mesh):
                 self.exp.step_timed()  # arm the step timer
                 # restart time predates the window just armed: drop it from
@@ -1186,6 +1250,19 @@ class Trainer:
                     # stop rides the same per-step cadence; steps outside
                     # the window are untouched (no syncs, no graph changes)
                     self.exp.maybe_trace(self.step)
+                    if self.preemption_notice is not None:
+                        # a sigterm-mode injection fired at the save/restore
+                        # point (outside this loop's scope): honor it like a
+                        # SIGTERM that landed there
+                        _request_stop(self.preemption_notice)
+                        self.preemption_notice = None
+                    if self.fault_injector is not None and \
+                            self.fault_injector.maybe_fire("step", self.step):
+                        # sigterm-mode injection: a preemption NOTICE — the
+                        # step still runs, then the boundary takes the
+                        # grace-window emergency checkpoint (kill mode raised
+                        # out of maybe_fire instead)
+                        _request_stop("injected preemption notice")
                     with spans.span("data_wait"):
                         batch = next(batches)
                     key = jax.random.fold_in(
@@ -1300,45 +1377,105 @@ class Trainer:
                         self.exp.log_metrics(
                             self.step, {"val_loss": last_metrics["val_loss"]}, force=True
                         )
-                    if ck_every and self.step % ck_every == 0:
+                    # ONE snapshot of the stop decision for this boundary:
+                    # the SIGTERM handler can run at any bytecode (including
+                    # inside the cadence save below), and deciding the stop
+                    # branch from a re-read would double-save this step —
+                    # orbax raises StepAlreadyExistsError.  A notice landing
+                    # mid-save stops at the NEXT boundary instead, still
+                    # inside the grace window.
+                    stopping = stop_requested["reason"] is not None
+                    if ck_every and self.step % ck_every == 0 and not stopping:
                         with spans.span("checkpoint"):
                             self.save_checkpoint(last_metrics)
-                    if stop_requested["reason"] is not None:
+                    if stopping:
                         logger.warning(
                             "stopping at step %d: %s — checkpointing for resume",
                             self.step, stop_requested["reason"],
                         )
-                        if self.checkpointer is not None and (
-                            not ck_every or self.step % ck_every != 0
-                        ):
+                        if self.checkpointer is not None:
+                            # emergency save: drained inside the grace window
+                            # so a background commit failure still counts as
+                            # a failed save while retries are possible — it
+                            # REPLACES the periodic save even when the stop
+                            # step lands on the cadence (an async cadence
+                            # save has no drain, no deadline, no guarantee)
                             with spans.span("checkpoint"):
-                                self.save_checkpoint(last_metrics)
+                                self.save_checkpoint(
+                                    last_metrics, emergency=True,
+                                    deadline=stop_requested["deadline"])
                         break
                 if (ck_every and self.checkpointer is not None
                         and stop_requested["reason"] is None and not halted):
                     with spans.span("checkpoint"):
                         self.save_checkpoint(last_metrics)  # final save
+                if self.preemption_notice is not None:
+                    # a notice that landed during the run's LAST save has no
+                    # loop iteration left to convert it: the run is already
+                    # complete and checkpointed, so record the fact in the
+                    # elastic trail instead of silently dropping it
+                    if stop_requested["reason"] is None:
+                        stop_requested["reason"] = self.preemption_notice
+                    logger.warning(
+                        "preemption notice during the final save: run "
+                        "already complete (%s)", self.preemption_notice)
+                    self.preemption_notice = None
         finally:
-            batches.close()
+            if batches is not None:
+                batches.close()
             if old_handler is not None:
                 import signal as _signal
 
                 _signal.signal(_signal.SIGTERM, old_handler)
-            if self.checkpointer is not None:
-                with spans.span("checkpoint"):
-                    self.checkpointer.wait()
-                    self.checkpointer.close()
-            if tel.goodput:
-                try:
-                    summary: dict[str, Any] = {
-                        "goodput": spans.goodput_summary()}
-                    if detector.events:
-                        summary["retrace_events"] = detector.events[-20:]
-                    self.exp.write_run_summary(summary)
-                except Exception as e:  # noqa: BLE001 — teardown must finish
-                    logger.warning("goodput summary write failed: %s", e)
-            self.exp.close()
+            try:
+                if self.checkpointer is not None:
+                    # the async-save drain: every exit path (clean, halt,
+                    # SIGTERM, exception) waits the in-flight commit.  A drain
+                    # failure still PROPAGATES (a lost save must be loud) —
+                    # the nested finally below just keeps it from eating the
+                    # goodput/elastic summaries and exp.close()
+                    with spans.span("checkpoint"):
+                        self.checkpointer.wait()
+                        self.checkpointer.close()
+            finally:
+                self._write_teardown_summaries(
+                    spans, detector, tel, resumed, stop_requested)
         return last_metrics
+
+    def _write_teardown_summaries(self, spans, detector, tel, resumed,
+                                  stop_requested) -> None:
+        """fit() teardown after the checkpoint drain: persist the goodput and
+        elastic sections of ``run_summary.json`` and close the exp manager.
+        Runs even when the drain raised."""
+        if tel.goodput:
+            try:
+                summary: dict[str, Any] = {
+                    "goodput": spans.goodput_summary()}
+                if detector.events:
+                    summary["retrace_events"] = detector.events[-20:]
+                self.exp.write_run_summary(summary)
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("goodput summary write failed: %s", e)
+        if resumed or self.replan_record is not None \
+                or stop_requested["reason"] is not None:
+            # the elastic trail (docs/elasticity.md): what the restart
+            # cost, whether a replan happened (old plan -> new plan), and
+            # why this incarnation stopped — metrics_report.py renders it
+            try:
+                snap = spans.snapshot()
+                section: dict[str, Any] = {
+                    "resumed": bool(resumed),
+                    "restart_seconds": round(snap.get("restart", 0.0), 3),
+                    "replan_seconds": round(snap.get("replan", 0.0), 3),
+                }
+                if stop_requested["reason"] is not None:
+                    section["stop_reason"] = stop_requested["reason"]
+                if self.replan_record is not None:
+                    section["replan"] = self.replan_record
+                self.exp.write_run_summary({"elastic": section})
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("elastic summary write failed: %s", e)
+        self.exp.close()
 
     def _compile_census(self, batch, key, spans) -> None:
         """First-compile census (telemetry.compile_census): AOT lower+compile
@@ -1481,13 +1618,41 @@ class Trainer:
             losses.append(float(m["val_loss"]))
         return float(np.mean(losses)) if losses else float("nan")
 
-    def save_checkpoint(self, metrics: Optional[dict[str, float]] = None) -> None:
+    def save_checkpoint(
+        self,
+        metrics: Optional[dict[str, float]] = None,
+        *,
+        emergency: bool = False,
+        deadline: Optional[float] = None,
+    ) -> None:
+        """One checkpoint save: the topology/plan manifest rides along
+        (world-size-agnostic resume, trainer.elastic), transient I/O errors
+        retry with backoff (``exp_manager.elastic.save_retries``), and
+        ``emergency=True`` (the SIGTERM grace window) drains the async commit
+        inside the retry loop bounded by ``deadline``."""
         if self.checkpointer is None:
             return
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            build_manifest,
+        )
+
         ds = dict(self.cfg.get("distributed_strategy", {}) or {})
         pp = int(ds.get("pipeline_model_parallel_size", 1))
         vp = int(ds.get("virtual_pipeline_model_parallel_size") or 1)
-        self.checkpointer.save(
+        try:
+            manifest = build_manifest(
+                self.cfg, self.mesh, step=self.step,
+                schedule=self.pipeline_schedule,
+                model_family=self.run_facts.get(
+                    "model_family", type(self.model_cfg).__name__),
+                save_bf16=self.checkpointer.config.save_bf16,
+            )
+        except Exception as e:  # noqa: BLE001 — a manifest failure must not
+            # block the save itself (the checkpoint stays resumable at the
+            # SAME world size without one)
+            logger.warning("manifest build failed (saving without): %s", e)
+            manifest = None
+        self.checkpointer.save_with_retry(
             TrainState(
                 params=self.params,
                 opt_state=self.opt_state,
@@ -1500,7 +1665,19 @@ class Trainer:
                                         else "flat")},
             ),
             metrics=metrics,
+            manifest=manifest,
+            force=emergency,
+            deadline=deadline,
+            drain=emergency,
         )
+        if self.fault_injector is not None:
+            # drill injection point "save": the save was INITIATED (an async
+            # save may be in flight) — the drain-on-teardown contract is what
+            # keeps a kill here from orphaning it; sigterm mode is a
+            # preemption notice landing mid-save
+            if self.fault_injector.maybe_fire("save", self.step):
+                self.preemption_notice = (
+                    "injected preemption notice (mid-save)")
 
 
 def build_model(cfg: ConfigDict, policy: DtypePolicy, *, shift_labels: bool = True):

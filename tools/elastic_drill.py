@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""Preemption drill harness: kill (or gracefully preempt) a tiny-llama run at
+a configurable point, resume it — optionally on a DIFFERENT device count, so
+the restart-time autotune replanner has to re-mesh — and prove the resumed
+loss trajectory matches an uninterrupted control run at pinned tolerance.
+
+This is the fleet-survivability acceptance gate for the elastic resume path
+(docs/elasticity.md): a health-halt or SIGTERM must leave the run one
+auto-resume away from continuing, whatever the post-shrink fleet looks like.
+
+    python tools/elastic_drill.py --smoke             # CI gate: dp 4 -> 2 kill drill
+    python tools/elastic_drill.py --at-step 3 --phase save --mode sigterm \
+        --world 4 --resume-world 8 --json -
+
+The drill runs single-process on the virtual CPU mesh (the same 8-device
+harness the test suite uses): "world size" is a device-subset choice, the
+kill is :class:`~neuronx_distributed_training_tpu.trainer.elastic.
+SimulatedPreemption` raised at the injection point — everything downstream of
+the signal (drain, manifest, replan, resharded restore, goodput accounting)
+is the REAL production path.  ``tests/test_elastic.py`` drives the same
+:func:`run_drill` entry, so the CLI and the regression suite cannot drift.
+
+A completed drill records ``restart_cost_seconds`` / ``goodput_fraction`` in
+``bench_results/last_drill.json``; ``bench.py`` picks the file up and carries
+both in its JSON line, so restart cost is visible in the bench trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+logger = logging.getLogger("nxdt.elastic_drill")
+
+#: where the last completed drill's headline numbers land (bench.py reads it)
+LAST_DRILL_PATH = "bench_results/last_drill.json"
+
+#: loss-trajectory pin for cross-dp resumes: the resumed run re-reduces the
+#: same global batches over a different dp grouping, so per-step losses agree
+#: to reduction-order noise, not bitwise (same-dp resumes ARE bitwise and the
+#: harness asserts exact equality there)
+DEFAULT_LOSS_TOL = 2e-3
+
+
+def tiny_llama_config(workdir: str | Path, *, name: str = "drill",
+                      max_steps: int = 6, save_every: int = 2,
+                      seed: int = 7) -> dict[str, Any]:
+    """The drill's tiny-llama raw config mapping: synthetic deterministic
+    data (content is a pure function of row index — identical batches at any
+    dp), per-step logging, goodput telemetry on, elastic resume on."""
+    return {
+        "name": name,
+        "model_source": "hf",
+        "seed": seed,
+        "trainer": {"max_steps": max_steps, "log_every_n_steps": 1},
+        "exp_manager": {
+            "exp_dir": str(workdir),
+            "resume_if_exists": True,
+            "checkpoint_callback_params": {
+                "save_top_k": 2, "every_n_train_steps": save_every,
+                "async_checkpointing": True,
+            },
+            "elastic": {"enabled": True, "grace_period_seconds": 10.0},
+            "telemetry": {"spans": True, "goodput": True,
+                          "compile_census": False, "mfu": False},
+        },
+        "distributed_strategy": {"tensor_model_parallel_size": 1,
+                                 "zero1": True},
+        "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                 "seq_length": 32, "synthetic": True},
+        "model": {
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+            "num_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 32,
+            "optim": {"name": "adamw_fp32OptState", "lr": 1e-3,
+                      "sched": {"name": "LinearAnnealingWithWarmUp",
+                                "warmup_steps": 2, "max_steps": max_steps}},
+        },
+        "precision": {"type": "mixed_precision"},
+    }
+
+
+def read_losses(run_dir: str | Path) -> dict[int, float]:
+    """``{step: loss}`` from a run dir's ``metrics.jsonl`` — last record per
+    step wins (a resumed run re-logs the steps it re-trains)."""
+    out: dict[int, float] = {}
+    path = Path(run_dir) / "metrics.jsonl"
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail line from a killed run
+        if isinstance(rec.get("step"), int) and "loss" in rec:
+            out[rec["step"]] = float(rec["loss"])
+    return out
+
+
+def _run_dir(cfg: Any) -> Path:
+    from neuronx_distributed_training_tpu.trainer.exp_manager import (
+        experiment_base_dir,
+        latest_version,
+    )
+
+    base = experiment_base_dir(dict(cfg))
+    v = latest_version(base)
+    return base / f"version_{v if v is not None else 0}"
+
+
+def run_segment(raw_cfg: dict, devices: list, *,
+                fault: Optional[Any] = None,
+                replan_world: Optional[int] = None) -> dict[str, Any]:
+    """One trainer incarnation of the drill: build (optionally after a
+    restart-time replan for ``replan_world`` chips), attach the fault
+    injector, run ``fit()``, and report what happened.
+
+    Returns ``{"killed": bool, "metrics": dict|None, "trainer": Trainer,
+    "run_dir": Path, "replanned": bool, "record": dict|None}`` — ``killed``
+    is True when the injected :class:`SimulatedPreemption` fired (the
+    simulated SIGKILL: fit() died, teardown still drained the async save)."""
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.trainer.elastic import (
+        SimulatedPreemption,
+        maybe_replan,
+    )
+    from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+    cfg = load_config(raw_cfg)
+    record = None
+    if replan_world is not None:
+        result = maybe_replan(cfg, int(replan_world))
+        cfg, record = result.cfg, result.record
+    trainer = Trainer.from_config(cfg, devices=list(devices))
+    if record is not None:
+        trainer.replan_record = record
+    if fault is not None:
+        trainer.fault_injector = fault
+    killed, metrics = False, None
+    try:
+        metrics = trainer.fit()
+    except SimulatedPreemption as e:
+        killed = True
+        logger.info("drill: %s", e)
+    return {"killed": killed, "metrics": metrics, "trainer": trainer,
+            "run_dir": _run_dir(cfg), "replanned": record is not None,
+            "record": record}
+
+
+def _tree_max_diff(a: Any, b: Any) -> float:
+    import jax
+    import numpy as np
+
+    diffs = jax.tree_util.tree_map(
+        lambda x, y: float(np.max(np.abs(
+            np.asarray(x, dtype=np.float64) - np.asarray(y, np.float64))))
+        if np.asarray(x).size else 0.0,
+        a, b,
+    )
+    return max(jax.tree_util.tree_leaves(diffs), default=0.0)
+
+
+def run_drill(workdir: str | Path, *, at_step: int = 3, phase: str = "step",
+              mode: str = "kill", world: int = 4,
+              resume_world: Optional[int] = 2, total_steps: int = 6,
+              save_every: int = 2, loss_tol: float = DEFAULT_LOSS_TOL,
+              record_path: Optional[str] = None) -> dict[str, Any]:
+    """The full drill: control run, injected fault, resume (replanned when
+    the world changed), trajectory + state comparison.  Raises
+    ``AssertionError`` with a diagnostic on any continuity violation.
+
+    Returns the drill report (the CLI's JSON payload)."""
+    import jax
+
+    from neuronx_distributed_training_tpu.trainer.elastic import FaultInjector
+
+    devices = jax.devices()
+    resume_world = int(resume_world if resume_world is not None else world)
+    if max(world, resume_world) > len(devices):
+        raise ValueError(
+            f"drill wants {max(world, resume_world)} devices, "
+            f"have {len(devices)}")
+    workdir = Path(workdir)
+
+    # 1. control: uninterrupted run at the original world size
+    control = run_segment(
+        tiny_llama_config(workdir / "control", max_steps=total_steps,
+                          save_every=save_every),
+        devices[:world])
+    assert control.get("metrics"), "control run produced no metrics"
+
+    # 2. the doomed run: same config, fault injected.  A restore-phase fault
+    # belongs to the RESUME incarnation (a fresh start never restores), so
+    # for phase="restore" the doomed run is interrupted by a plain step kill
+    # — its job is only to leave an interrupted run + checkpoint behind.
+    drill_cfg = tiny_llama_config(workdir / "drill", max_steps=total_steps,
+                                  save_every=save_every)
+    doomed_fault = (FaultInjector(at_step=at_step, mode="kill", phase="step")
+                    if phase == "restore"
+                    else FaultInjector(at_step=at_step, mode=mode, phase=phase))
+    doomed = run_segment(drill_cfg, devices[:world], fault=doomed_fault)
+    if mode == "kill" or phase == "restore":
+        assert doomed["killed"], (
+            f"FaultInjector({doomed_fault.mode}, {doomed_fault.phase}, "
+            f"step {at_step}) never fired — the drill tested nothing")
+    else:
+        # sigterm mode completes fit() normally, so "killed" proves nothing:
+        # the injector's own fired flag is the evidence the grace-window
+        # path was exercised (e.g. an at_step past the last boundary would
+        # otherwise produce a clean run and a misleading downstream failure)
+        assert doomed_fault.fired, (
+            f"FaultInjector(sigterm, {phase}, step {at_step}) never fired — "
+            f"the drill tested nothing (at_step past the last boundary?)")
+    # the drain-on-teardown contract: whatever save was in flight when the
+    # fault hit must have committed — a resumable checkpoint exists
+    from neuronx_distributed_training_tpu.trainer.elastic import (
+        discover_checkpoint_dir,
+        read_latest_manifest,
+    )
+    from neuronx_distributed_training_tpu.config.loader import load_config
+
+    ck_dir = discover_checkpoint_dir(load_config(drill_cfg))
+    assert ck_dir is not None, "no checkpoint survived the injected fault"
+    manifest = read_latest_manifest(ck_dir)
+    assert manifest is not None, (
+        f"checkpoint under {ck_dir} has no topology manifest — "
+        f"world-size-agnostic resume is broken")
+    assert int(manifest["world_size"]) == world, manifest
+
+    # 3. resume — on the (possibly different) world; replan when it changed.
+    # phase="restore": the fault rides the FIRST resume incarnation (kill
+    # dies mid-restore, sigterm is a notice landing mid-restore) and a
+    # second, clean resume proves the save survived and the run continues.
+    replan_world = resume_world if resume_world != world else None
+    replanned, record = False, None
+    if phase == "restore":
+        # at_step=0: fire on the first restore, whatever step it resumes
+        restore_fault = FaultInjector(at_step=0, mode=mode, phase="restore")
+        faulted = run_segment(
+            drill_cfg, devices[:resume_world], fault=restore_fault,
+            replan_world=replan_world)
+        replanned, record = faulted["replanned"], faulted["record"]
+        assert restore_fault.fired, (
+            "FaultInjector(restore) never fired on the resume incarnation — "
+            "the drill tested nothing")
+        if mode == "kill":
+            assert faulted["killed"], (
+                f"FaultInjector(kill, restore, step 0) never fired on the "
+                f"resume incarnation — the drill tested nothing")
+            # a kill mid-restore (checkpoint read, nothing applied) must
+            # leave the save untouched and still resumable
+            m2 = read_latest_manifest(ck_dir)
+            assert m2 is not None and int(m2["step"]) == int(
+                manifest["step"]), (
+                f"mid-restore kill corrupted the checkpoint: manifest "
+                f"{manifest.get('step')} -> {m2 and m2.get('step')}")
+        else:
+            assert faulted.get("metrics") is not None, (
+                "sigterm-mode restore drill produced no metrics")
+    resumed = run_segment(drill_cfg, devices[:resume_world],
+                          replan_world=replan_world)
+    assert resumed.get("metrics"), "resumed run produced no metrics"
+    replanned = replanned or resumed["replanned"]
+    record = resumed["record"] or record
+    if resume_world != world:
+        assert replanned, (
+            f"world changed {world} -> {resume_world} but no replan happened")
+
+    # 4. loss-trajectory continuity: every step the resumed run trained must
+    # match the control at pinned tolerance (identical synthetic batches,
+    # different dp reduction grouping)
+    control_losses = read_losses(control["run_dir"])
+    drill_losses = read_losses(resumed["run_dir"])
+    common = sorted(set(control_losses) & set(drill_losses))
+    assert common and max(common) == total_steps, (
+        f"resumed run never reached step {total_steps}: "
+        f"control={sorted(control_losses)}, drill={sorted(drill_losses)}")
+    worst = max(abs(control_losses[s] - drill_losses[s]) for s in common)
+    assert worst <= loss_tol, (
+        f"loss trajectory diverged after resume: max |Δloss|={worst:.3e} "
+        f"> {loss_tol:.0e} over steps {common}")
+
+    # 5. state equivalence at the horizon: bitwise at the same world size,
+    # pinned tolerance across a reshard
+    params_diff = _tree_max_diff(control["trainer"].params,
+                                 resumed["trainer"].params)
+    if resume_world == world and not replanned:
+        assert params_diff == 0.0, (
+            f"same-world resume must be bitwise: max param diff {params_diff:.3e}")
+    else:
+        assert params_diff <= loss_tol, (
+            f"cross-world resume params diverged: max diff {params_diff:.3e}")
+
+    # 6. the restart cost is accounted: run_summary.json carries the elastic
+    # trail + goodput breakdown for the resumed incarnation
+    summary = {}
+    summary_path = Path(resumed["run_dir"]) / "run_summary.json"
+    if summary_path.exists():
+        summary = json.loads(summary_path.read_text())
+    elastic_sec = dict(summary.get("elastic") or {})
+    goodput = dict(summary.get("goodput") or {})
+    assert elastic_sec.get("resumed"), (
+        f"run_summary.json has no elastic resume trail: {summary_path}")
+    restart_cost = (float(elastic_sec.get("restart_seconds", 0.0))
+                    + float(elastic_sec.get("replan_seconds", 0.0)))
+    import time
+
+    report = {
+        "ok": True,
+        # stamp the drill like bench.py stamps last_measured.json — a stale
+        # drill riding later bench lines must be recognizable as stale
+        "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "at_step": at_step, "phase": phase, "mode": mode,
+        "world": world, "resume_world": resume_world,
+        "total_steps": total_steps,
+        "resume_step": int(manifest.get("step", -1)),
+        "replanned": replanned,
+        "old_plan": (record or {}).get("old_plan"),
+        "new_plan": (record or {}).get("new_plan"),
+        "max_loss_diff": worst,
+        "max_param_diff": params_diff,
+        "loss_tol": loss_tol,
+        "restart_cost_seconds": round(restart_cost, 3),
+        "goodput_fraction": goodput.get("goodput_fraction"),
+        "run_dir": str(resumed["run_dir"]),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(record_path) or ".", exist_ok=True)
+        with open(record_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return report
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: the canonical dp 4 -> 2 kill drill in a "
+                         "temp dir (single process, virtual CPU devices) — "
+                         "these ARE the defaults; the flag just documents "
+                         "intent in CI command lines")
+    ap.add_argument("--at-step", type=int, default=3)
+    ap.add_argument("--phase", choices=["step", "save", "restore"],
+                    default="step")
+    ap.add_argument("--mode", choices=["kill", "sigterm"], default="kill")
+    ap.add_argument("--world", type=int, default=4,
+                    help="device count of the original run")
+    ap.add_argument("--resume-world", type=int, default=2,
+                    help="device count after the 'preemption' (different "
+                         "value triggers the restart-time replan)")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--loss-tol", type=float, default=DEFAULT_LOSS_TOL)
+    ap.add_argument("--workdir", default=None,
+                    help="drill working dir (default: a fresh temp dir)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the drill report as JSON ('-' = stdout, "
+                         "last line, tools/_jsonout contract)")
+    ap.add_argument("--no-record", action="store_true",
+                    help=f"do not refresh {LAST_DRILL_PATH}")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    # force the 8-device virtual CPU platform BEFORE jax initializes devices
+    # (same dance as tests/conftest.py — sitecustomize may have imported jax)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="nxdt_elastic_drill_")
+    record_path = None if args.no_record else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", LAST_DRILL_PATH)
+    try:
+        report = run_drill(
+            workdir,
+            at_step=args.at_step, phase=args.phase, mode=args.mode,
+            world=args.world, resume_world=args.resume_world,
+            total_steps=args.steps, save_every=args.save_every,
+            loss_tol=args.loss_tol,
+            record_path=(os.path.normpath(record_path)
+                         if record_path else None),
+        )
+    except AssertionError as e:
+        logger.error("drill FAILED: %s", e)
+        if args.json:
+            from _jsonout import write_json
+
+            write_json({"ok": False, "error": str(e)}, args.json)
+        return 1
+    logger.info(
+        "drill OK: killed at step %d (%s/%s), resumed %d -> %d devices "
+        "from step %d; max |Δloss| %.2e, restart cost %.2fs, goodput %.4f",
+        report["at_step"], report["mode"], report["phase"], report["world"],
+        report["resume_world"], report["resume_step"],
+        report["max_loss_diff"], report["restart_cost_seconds"],
+        report["goodput_fraction"] or 0.0,
+    )
+    if args.json:
+        from _jsonout import write_json
+
+        write_json(report, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

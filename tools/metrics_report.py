@@ -101,6 +101,54 @@ def goodput_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def _plan_str(plan: dict) -> str:
+    # deliberate copy of trainer/elastic.py::_plan_str — importing it would
+    # pull the package __init__ (and jax) into this stdlib-only tool; keep
+    # the two in sync when the plan record grows a rendered key
+    keys = ("dp", "tp", "pp", "cp", "ep", "vp")
+    parts = [f"{k}={plan[k]}" for k in keys if plan.get(k) is not None]
+    if plan.get("micro_batch_size") is not None:
+        parts.append(f"mbs={plan['micro_batch_size']}")
+    if plan.get("schedule") not in (None, "none"):
+        parts.append(f"sched={plan['schedule']}")
+    return " ".join(parts) or "?"
+
+
+def elastic_section(summary: dict) -> str:
+    """Restart/replan trail (trainer.elastic -> run_summary.json "elastic"):
+    whether this incarnation resumed, what the restart cost in span time,
+    and — when the world size changed — the old plan -> new plan record the
+    restart-time autotune replanner imposed (docs/elasticity.md)."""
+    el = summary.get("elastic")
+    if not isinstance(el, dict) or not el:
+        return ""
+    lines = ["", "elastic (restart/replan trail — docs/elasticity.md)"]
+    lines.append(f"  resumed               {bool(el.get('resumed'))}")
+    for key in ("restart_seconds", "replan_seconds"):
+        if el.get(key) is not None:
+            lines.append(f"  {key:<21} {_fmt(el[key])}")
+    if el.get("stop_reason"):
+        lines.append(f"  stop_reason           {el['stop_reason']}")
+    rec = el.get("replan")
+    if isinstance(rec, dict) and rec:
+        lines.append(
+            f"  replanned             world "
+            f"{rec.get('old_world', '?')} -> {rec.get('new_world', '?')} "
+            f"chips (resuming step {rec.get('checkpoint_step', '?')})")
+        lines.append(f"    old plan            "
+                     f"{_plan_str(rec.get('old_plan') or {})}")
+        lines.append(f"    new plan            "
+                     f"{_plan_str(rec.get('new_plan') or {})}")
+        if rec.get("predicted_step_seconds") is not None:
+            lines.append(f"    predicted_step      "
+                         f"{_fmt(rec['predicted_step_seconds'])} s")
+        if rec.get("skipped_incompatible"):
+            lines.append(f"    skipped             "
+                         f"{rec['skipped_incompatible']} layout-incompatible "
+                         f"candidate(s)")
+    return "\n".join(lines)
+
+
 def anomalies_section(summary: dict) -> str:
     """Flight-recorder trail: one line per forensic bundle the run dumped
     (render a bundle itself with ``tools/anomaly_report.py``)."""
@@ -197,6 +245,7 @@ def render(metrics_path: str | None, summary_path: str | None,
             parts.append(f"unreadable {summary_path}: {e}")
     if summary:
         parts.append(goodput_section(summary))
+        parts.append(elastic_section(summary))
         parts.append(anomalies_section(summary))
         parts.append(census_section(summary))
     if trace_path and os.path.exists(trace_path):
