@@ -913,6 +913,10 @@ def main() -> None:
             for k in ("date", "mode", "phase", "world", "resume_world",
                       "replanned", "max_loss_diff")
         }
+        if drill.get("integrity"):
+            # corruption-drill leg (elastic_drill --smoke): which injection
+            # kind was survived and how far the walk-back went
+            payload["drill"]["integrity"] = drill["integrity"]
     if errors:
         payload["regime_errors"] = errors
     if backend_err:

@@ -8,6 +8,12 @@ tensor-streaming role), async save runs in a background thread (the
 ``async_checkpointing`` role), retention keeps top-k + last.
 """
 
+from neuronx_distributed_training_tpu.checkpoint.integrity import (  # noqa: F401
+    CheckpointIntegrityError,
+    IntegrityConfig,
+    StepVerification,
+    inject_corruption,
+)
 from neuronx_distributed_training_tpu.checkpoint.manager import (  # noqa: F401
     CheckpointConfig,
     Checkpointer,

@@ -31,6 +31,13 @@ import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from neuronx_distributed_training_tpu.checkpoint import integrity as ck_integrity
+from neuronx_distributed_training_tpu.checkpoint.integrity import (
+    CheckpointIntegrityError,
+    IntegrityConfig,
+    SaveAuditor,
+)
+
 logger = logging.getLogger(__name__)
 
 #: errno values treated as TRANSIENT save-I/O failures (full disk being
@@ -84,6 +91,12 @@ class CheckpointConfig:
     # shadows the last good one
     save_retries: int = 3
     save_retry_backoff_seconds: float = 0.5
+    # checkpoint-integrity policy (``exp_manager.checkpoint.integrity``,
+    # docs/elasticity.md "Integrity & walk-back"): digest sidecar in every
+    # save, verified restore with walk-back + quarantine, optional
+    # post-commit read-back audit
+    integrity: IntegrityConfig = dataclasses.field(
+        default_factory=IntegrityConfig)
 
     @classmethod
     def from_config(cls, cfg: dict[str, Any]) -> "CheckpointConfig":
@@ -108,6 +121,7 @@ class CheckpointConfig:
                 cb.get("use_master_weights_in_ckpt", True)),
             save_retries=el.save_retries,
             save_retry_backoff_seconds=el.save_retry_backoff_seconds,
+            integrity=ck_integrity.parse_checkpoint_block(em.get("checkpoint")),
         )
 
 
@@ -245,6 +259,21 @@ class Checkpointer:
                 save_interval_steps=1,
             )
         self._mgr = ocp.CheckpointManager(directory, options=options)
+        #: integrity bookkeeping — the restore/audit trail the trainer
+        #: persists into ``run_summary.json``'s ``integrity`` section
+        self.integrity_trail: dict[str, Any] = {}
+        #: steps saved but not yet handed to the post-commit audit (they
+        #: commit at the next ``wait()``/``save()``; the audit only ever sees
+        #: COMMITTED steps)
+        self._audit_pending: list[int] = []
+        self._auditor: Optional[SaveAuditor] = None
+        if config.integrity.enabled and config.integrity.audit:
+            self._auditor = SaveAuditor(self.directory)
+
+    def _trail(self) -> dict[str, Any]:
+        self.integrity_trail.setdefault("quarantined_steps", [])
+        self.integrity_trail.setdefault("verify_seconds", 0.0)
+        return self.integrity_trail
 
     @property
     def directory(self):
@@ -270,7 +299,23 @@ class Checkpointer:
         (``trainer.elastic.build_manifest``): mesh axes, parallelism plan,
         model identity.  Stored as its own JSON item so a restart can read
         it WITHOUT templates (the restart-time replanner does exactly that
-        before any model state exists)."""
+        before any model state exists).
+
+        When integrity is enabled the save also carries the ``integrity``
+        digest sidecar (docs/elasticity.md "Integrity & walk-back"), and —
+        with the post-commit audit on — previously COMMITTED steps are
+        handed to the background auditor here, with any finished
+        audit-failure verdict applied (quarantine) before the new save
+        starts.  The verdict application is a non-blocking snapshot: an
+        audit still in flight never delays (or deadlocks) a save, emergency
+        or periodic."""
+        if self._auditor is not None:
+            # the implicit wait also commits any in-flight async save, so
+            # the steps kicked to the auditor are guaranteed on disk; orbax
+            # would serialize on the previous save here anyway
+            self._mgr.wait_until_finished()
+            self._kick_audits()
+            self._apply_audit_verdicts()
         params = state.params
         if self.config.save_bf16:
             import jax.numpy as jnp
@@ -298,12 +343,77 @@ class Checkpointer:
         }
         if manifest is not None:
             items["manifest"] = ocp.args.JsonSave(manifest)
-        return self._mgr.save(
+        if self.config.integrity.enabled:
+            # digests over the EXACT trees handed to orbax (post save_bf16
+            # cast / master drop) so restore verification re-hashes the same
+            # bytes it reads back from disk.  COST: a synchronous
+            # device->host fetch + hash of the full state on this thread —
+            # comparable to the host snapshot an async save itself takes,
+            # but paid twice; at very large scale where that matters, turn
+            # the sidecar off (integrity.enabled: false) or budget the
+            # checkpoint cadence for it (docs/elasticity.md)
+            try:
+                items[ck_integrity.INTEGRITY_ITEM] = ocp.args.JsonSave(
+                    ck_integrity.build_sidecar(
+                        step=int(state.step), params=params,
+                        opt_state=opt_state, meta=meta, manifest=manifest))
+            except Exception as e:  # noqa: BLE001 — a sidecar failure must
+                # not block the save itself (the step then restores as
+                # legacy/unverified, with the warning)
+                logger.warning(
+                    "integrity sidecar build failed at step %d (saving "
+                    "without): %s", state.step, e)
+        saved = self._mgr.save(
             int(state.step),
             args=ocp.args.Composite(**items),
             metrics={k: float(v) for k, v in (metrics or {}).items()},
             force=force,
         )
+        if saved and self._auditor is not None:
+            self._audit_pending.append(int(state.step))
+        return saved
+
+    # -- post-commit save audit --------------------------------------------
+
+    def _kick_audits(self) -> None:
+        """Hand every pending (now committed) step to the background
+        auditor.  Callers guarantee no async save is in flight."""
+        if self._auditor is None:
+            return
+        pending, self._audit_pending = self._audit_pending, []
+        for step in pending:
+            self._auditor.schedule(step)
+
+    def _apply_audit_verdicts(self) -> list[int]:
+        """Snapshot the auditor's COMPLETED verdicts (non-blocking) and
+        quarantine any audit failure.  Safe only when no save is in flight
+        (quarantine reloads the manager's step registry)."""
+        if self._auditor is None:
+            return []
+        quarantined: list[int] = []
+        trail = self._trail()
+        for v in self._auditor.poll():
+            if v.status != "corrupt":
+                continue
+            logger.error(
+                "post-commit save audit FAILED for step %d: %s",
+                v.step, "; ".join(v.failures[:4]))
+            if self.config.integrity.quarantine:
+                ck_integrity.apply_quarantine(
+                    self.directory, v.step, reason="save-audit",
+                    failures=v.failures)
+                self._mgr.reload()
+                quarantined.append(v.step)
+                trail.setdefault("audit_quarantined", []).append(v.step)
+                if v.step not in trail["quarantined_steps"]:
+                    trail["quarantined_steps"].append(v.step)
+            else:
+                trail.setdefault("corrupt_steps_unquarantined", [])
+                if v.step not in trail["corrupt_steps_unquarantined"]:
+                    trail["corrupt_steps_unquarantined"].append(v.step)
+        if self._auditor is not None:
+            trail["audit"] = self._auditor.stats.to_dict()
+        return quarantined
 
     def save_with_retry(
         self,
@@ -418,13 +528,101 @@ class Checkpointer:
             logger.warning("partial-save cleanup at step %d failed: %s", step, e)
 
     def wait(self) -> None:
-        """Block until any in-flight async save commits."""
+        """Block until any in-flight async save commits.  With the
+        post-commit audit on, the freshly committed steps are handed to the
+        background auditor here and any finished verdict is applied — still
+        without ever blocking on an audit in flight."""
         self._mgr.wait_until_finished()
+        if self._auditor is not None:
+            self._kick_audits()
+            self._apply_audit_verdicts()
 
     # -- restore ------------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def verify_step(self, step: int) -> "ck_integrity.StepVerification":
+        """Template-free integrity verification of one retained step
+        (:func:`checkpoint.integrity.verify_step` over this manager)."""
+        return ck_integrity.verify_step(self.directory, step, mgr=self._mgr)
+
+    def verified_latest_step(
+        self, *, quarantine: Optional[bool] = None
+    ) -> Optional[int]:
+        """The newest retained step that passes integrity verification,
+        walking BACK through the retention chain past corrupt steps (each
+        quarantined: renamed out of the discovery namespace + ledger entry,
+        so restore, elastic replan, and every later discovery agree on the
+        same step).  ``None`` when no checkpoint exists at all; raises
+        :class:`CheckpointIntegrityError` with the per-step verdicts when
+        steps exist but NONE verifies.
+
+        A step without a sidecar (pre-integrity checkpoint) verifies as
+        ``legacy`` — restorable with a warning, never a crash."""
+        icfg = self.config.integrity
+        quarantine = icfg.quarantine if quarantine is None else quarantine
+        steps = sorted(self._mgr.all_steps() or [], reverse=True)
+        if not steps:
+            return None
+        trail = self._trail()
+        verdicts: list[ck_integrity.StepVerification] = []
+        walked = 0
+        for step in steps:
+            v = self.verify_step(step)
+            verdicts.append(v)
+            trail["verify_seconds"] = round(
+                trail["verify_seconds"] + v.seconds, 3)
+            if v.status == "gone":
+                # the dir vanished between the step listing and the read
+                # (concurrent quarantine/retention on another actor):
+                # nothing to restore OR quarantine — keep walking
+                logger.warning(
+                    "checkpoint step %d vanished mid-verification — "
+                    "skipping (concurrent retention/quarantine?)", step)
+                continue
+            if v.passed:
+                if v.status == "legacy":
+                    logger.warning(
+                        "checkpoint step %d predates integrity sidecars — "
+                        "restoring UNVERIFIED (legacy checkpoint; the next "
+                        "save will carry digests)", step)
+                    trail["legacy_restore"] = True
+                if walked:
+                    logger.warning(
+                        "integrity walk-back: restored step is %d, %d newer "
+                        "step(s) quarantined as corrupt", step, walked)
+                trail["verified_step"] = int(step)
+                trail["walk_back_count"] = walked
+                return int(step)
+            walked += 1
+            if quarantine:
+                ck_integrity.apply_quarantine(
+                    self.directory, step, reason=v.failures[0] if v.failures
+                    else "digest-mismatch", failures=v.failures)
+                self._mgr.reload()
+                if step not in trail["quarantined_steps"]:
+                    trail["quarantined_steps"].append(int(step))
+            else:
+                # walked past but deliberately NOT renamed/ledgered
+                # (quarantine: false, or a warm start in someone else's run
+                # dir) — the trail must not claim a quarantine that never
+                # happened
+                trail.setdefault("corrupt_steps_unquarantined", [])
+                if step not in trail["corrupt_steps_unquarantined"]:
+                    trail["corrupt_steps_unquarantined"].append(int(step))
+        if all(v.status == "gone" for v in verdicts):
+            # every listed step vanished under us: nothing to restore
+            return None
+        detail = "; ".join(
+            f"step {v.step}: {v.failures[0] if v.failures else v.status}"
+            for v in verdicts)
+        raise CheckpointIntegrityError(
+            f"every retained checkpoint under {self.directory} failed "
+            f"integrity verification ({detail}) — auto-resume cannot "
+            f"proceed; restore from an older backup or relaunch fresh "
+            f"(quarantined step dirs keep the evidence, see "
+            f"{ck_integrity.LEDGER_NAME})", verdicts)
 
     def read_manifest(self, step: Optional[int] = None) -> Optional[dict]:
         """The topology/plan manifest saved alongside ``step`` (newest when
@@ -459,10 +657,34 @@ class Checkpointer:
         mesh: Optional[Mesh] = None,
         param_specs: Any = None,
         opt_specs: Any = None,
+        verify: Optional[bool] = None,
     ) -> TrainState:
         """Restore the newest (or given) step.  Templates are live pytrees or
-        ShapeDtypeStructs; pass mesh+specs to restore direct-to-sharded."""
-        step = step if step is not None else self.latest_step()
+        ShapeDtypeStructs; pass mesh+specs to restore direct-to-sharded.
+
+        ``verify`` (default: the ``exp_manager.checkpoint.integrity`` knobs)
+        — verify the integrity sidecar BEFORE imposing the mesh: newest-step
+        restores walk back past corrupt steps (:meth:`verified_latest_step`);
+        an explicitly requested corrupt ``step`` raises
+        :class:`CheckpointIntegrityError` instead of restoring bad bytes."""
+        icfg = self.config.integrity
+        do_verify = (icfg.enabled and icfg.verify_restore
+                     if verify is None else bool(verify))
+        if step is None:
+            step = (self.verified_latest_step() if do_verify
+                    else self.latest_step())
+        elif do_verify:
+            v = self.verify_step(step)
+            if not v.passed:
+                raise CheckpointIntegrityError(
+                    f"checkpoint step {step} under {self.directory} failed "
+                    f"integrity verification: "
+                    f"{'; '.join(v.failures[:4]) or v.status}", [v])
+            if v.status == "legacy":
+                logger.warning(
+                    "checkpoint step %d predates integrity sidecars — "
+                    "restoring UNVERIFIED (legacy checkpoint)", step)
+                self._trail()["legacy_restore"] = True
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
         # meta first: the save-time knobs (save_bf16, master dropped) change
@@ -515,9 +737,27 @@ class Checkpointer:
         step: Optional[int] = None,
         mesh: Optional[Mesh] = None,
         param_specs: Any = None,
+        verify: Optional[bool] = None,
     ) -> Any:
         """The reference's ``weight_init_only`` warm start
-        (``nlp_overrides.py:565-568``): weights without optimizer/loop state."""
+        (``nlp_overrides.py:565-568``): weights without optimizer/loop state.
+
+        Integrity verification applies here too, but WITHOUT quarantine by
+        default — the warm-start source is usually someone else's run dir
+        (or a converter's output, which has no sidecar and restores as
+        legacy); renaming steps there is not this run's call."""
+        icfg = self.config.integrity
+        do_verify = (icfg.enabled and icfg.verify_restore
+                     if verify is None else bool(verify))
+        if step is None and do_verify:
+            step = self.verified_latest_step(quarantine=False)
+        elif step is not None and do_verify:
+            v = self.verify_step(step)
+            if not v.passed:
+                raise CheckpointIntegrityError(
+                    f"warm-start checkpoint step {step} under "
+                    f"{self.directory} failed integrity verification: "
+                    f"{'; '.join(v.failures[:4]) or v.status}", [v])
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
@@ -543,6 +783,20 @@ class Checkpointer:
         return params
 
     def close(self) -> None:
+        if self._auditor is not None:
+            # the teardown drain is DEADLINE-BOUNDED (integrity.
+            # audit_deadline_seconds): a hung store read on the audit thread
+            # must not wedge process exit — unfinished audits are counted
+            # ``incomplete`` in the trail instead
+            try:
+                self._mgr.wait_until_finished()
+                self._kick_audits()
+                self._auditor.drain(
+                    self.config.integrity.audit_deadline_seconds)
+                self._apply_audit_verdicts()
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("save-audit teardown drain failed: %s", e)
+            self._auditor.close(timeout=0)
         self._mgr.close()
 
     def __enter__(self) -> "Checkpointer":

@@ -428,6 +428,20 @@ def validate_config(cfg: ConfigDict) -> None:
 
         ElasticConfig.from_config(em.get("elastic"))
 
+    # ---- exp_manager.checkpoint ------------------------------------------
+    # checkpoint-integrity policy knobs (docs/elasticity.md "Integrity &
+    # walk-back"): digest sidecars, verified restore + walk-back/quarantine,
+    # post-commit save audit.  parse_checkpoint_block rejects unknown keys
+    # with a did-you-mean hint — a typo'd knob must not silently run with
+    # defaults.  (The reference-schema ``checkpoint_callback_params`` block
+    # keeps its separate, permissive home.)
+    if isinstance(em, Mapping) and "checkpoint" in em:
+        from neuronx_distributed_training_tpu.checkpoint.integrity import (
+            parse_checkpoint_block,
+        )
+
+        parse_checkpoint_block(em.get("checkpoint"))
+
     # ---- model alignment --------------------------------------------------
     # root-level key (reference hf_llama3_8B_DPO_config.yaml:7); accepts a
     # bare string ("dpo") or a one-key block ({dpo: {beta: ...}})

@@ -17,6 +17,7 @@ from neuronx_distributed_training_tpu.data.packing import (  # noqa: F401
 )
 from neuronx_distributed_training_tpu.data.loader import (  # noqa: F401
     DataModule,
+    DataStallError,
     HFDataModule,
     PrefetchIterator,
     SyntheticDataModule,

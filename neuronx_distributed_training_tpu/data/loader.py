@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Iterator, Optional, Sequence
 
 import jax
@@ -29,6 +30,15 @@ from neuronx_distributed_training_tpu.data.sampler import PretrainingSampler, Ra
 from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
 
 
+class DataStallError(RuntimeError):
+    """The upstream data iterator produced nothing for longer than the
+    configured ``data_wait`` timeout — a dead mount, a wedged arrow page-in,
+    a remote store hang.  Raised by :class:`PrefetchIterator` instead of
+    blocking the step boundary forever; the trainer dumps a hang-watchdog
+    forensic bundle before re-raising (``exp_manager.telemetry.health.
+    data_wait_timeout_seconds``, docs/observability.md)."""
+
+
 class PrefetchIterator:
     """Bounded background prefetch over a batch iterator.
 
@@ -38,13 +48,22 @@ class PrefetchIterator:
     loop thread still stalls dispatch.  A daemon thread keeps ``depth``
     batches ready in a queue; exceptions propagate to the consumer at the
     point they would have occurred.  ``close()`` (or GC) stops the thread.
+
+    ``timeout_seconds`` (> 0) arms the data-stall watchdog: a ``__next__``
+    that finds nothing for that long raises :class:`DataStallError` with a
+    curated diagnosis instead of freezing the run silently.  The timeout is
+    per-batch wait, not cumulative — a healthy-but-slow source that keeps
+    producing within the bound never trips it.
     """
 
     _DONE = object()
 
-    def __init__(self, it: Iterator, depth: int = 2):
+    def __init__(self, it: Iterator, depth: int = 2,
+                 timeout_seconds: Optional[float] = None):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        self._timeout = (float(timeout_seconds)
+                         if timeout_seconds and timeout_seconds > 0 else None)
         # the thread target captures ONLY the queue/event/sentinel — never
         # self — so an abandoned iterator stays collectible: __del__ then
         # fires, stops the thread, and the queued device batches are freed
@@ -82,6 +101,7 @@ class PrefetchIterator:
     def __next__(self):
         # timeout loop so a consumer blocked here wakes up after close()
         # (the producer may have died without enqueueing the sentinel)
+        waited_from = time.monotonic() if self._timeout is not None else None
         while True:
             try:
                 item = self._q.get(timeout=0.1)
@@ -89,6 +109,19 @@ class PrefetchIterator:
             except queue.Empty:
                 if self._stop.is_set():
                     raise StopIteration
+                if (waited_from is not None
+                        and time.monotonic() - waited_from > self._timeout):
+                    state = ("still running — the source itself is hung "
+                             "(dead mount? wedged arrow page-in? remote "
+                             "store stall?)" if self._thread.is_alive()
+                             else "DEAD without raising")
+                    raise DataStallError(
+                        f"data_wait exceeded {self._timeout:.0f}s with no "
+                        f"batch from the upstream iterator (prefetch thread "
+                        f"{state}); raise exp_manager.telemetry.health."
+                        f"data_wait_timeout_seconds for a legitimately "
+                        f"slower source, or 0 to disable this watchdog"
+                    )
         if item is self._DONE:
             # terminal: mark stopped so REPEAT next() calls keep raising
             # StopIteration (iterator protocol) instead of polling forever

@@ -65,6 +65,13 @@ class HealthConfig:
     max_bundles: int = 8
     watchdog_timeout_seconds: float = 0.0
     watchdog_abort: bool = True
+    # data-stall watchdog (data/loader.py PrefetchIterator): a hung upstream
+    # iterator (dead mount, wedged arrow page-in) otherwise blocks the step
+    # boundary FOREVER with no diagnosis.  > 0: the data_wait span raises a
+    # curated DataStallError after this many seconds and (health enabled)
+    # dumps a hang bundle first.  0 disables.  Independent of ``enabled`` —
+    # the curated error is useful even without the flight recorder.
+    data_wait_timeout_seconds: float = 0.0
 
     @classmethod
     def from_config(cls, block: Any) -> "HealthConfig":
@@ -121,6 +128,9 @@ class HealthConfig:
                            cls.watchdog_timeout_seconds)),
             watchdog_abort=bool(values.get("watchdog_abort",
                                            cls.watchdog_abort)),
+            data_wait_timeout_seconds=float(
+                values.get("data_wait_timeout_seconds",
+                           cls.data_wait_timeout_seconds)),
         )
         if out.ring_buffer_steps < 1:
             raise ValueError(
@@ -144,6 +154,12 @@ class HealthConfig:
                 "exp_manager.telemetry.health.watchdog_timeout_seconds > 0 "
                 "requires health.enabled: true (the watchdog dumps through "
                 "the flight recorder) — it would otherwise silently never arm"
+            )
+        if out.data_wait_timeout_seconds < 0:
+            raise ValueError(
+                f"exp_manager.telemetry.health.data_wait_timeout_seconds "
+                f"must be >= 0 (0 disables the data-stall watchdog), got "
+                f"{out.data_wait_timeout_seconds}"
             )
         return out
 

@@ -171,11 +171,20 @@ def main() -> None:
     elastic_cfg = ElasticConfig.from_config(
         dict(cfg.get("exp_manager", {}) or {}).get("elastic"))
     if elastic_cfg.enabled:
+        from neuronx_distributed_training_tpu.checkpoint import (
+            CheckpointIntegrityError,
+        )
+
         try:
             replan = maybe_replan(cfg, len(jax.devices()), elastic=elastic_cfg)
         except ElasticResumeError as e:
             # curated operator-facing refusal (the message carries the --set
             # remediation) — a clean one-line exit, not a traceback
+            raise SystemExit(f"elastic resume refused: {e}") from e
+        except CheckpointIntegrityError as e:
+            # every retained checkpoint failed verification at discovery —
+            # the message names each step's verdict (docs/elasticity.md
+            # "Integrity & walk-back")
             raise SystemExit(f"elastic resume refused: {e}") from e
         if replan.replanned:
             cfg = replan.cfg
@@ -253,6 +262,12 @@ def main() -> None:
         # the old-plan -> new-plan record in run_summary.json's elastic
         # section at teardown
         trainer.replan_record = replan.record
+    if replan is not None and replan.integrity_trail:
+        # discovery already verified (and possibly quarantined/walked back):
+        # carry that trail so run_summary.json's integrity section reflects
+        # the WHOLE restore story, not just the trainer's own (already
+        # cleaned) restore
+        trainer.discovery_integrity_trail = replan.integrity_trail
     if plan_report is not None:
         # the chosen plan becomes a static run fact: the compile census
         # carries it, and run_summary.json gets the full ranked report

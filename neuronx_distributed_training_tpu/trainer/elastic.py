@@ -226,22 +226,52 @@ def discover_checkpoint_dir(cfg: Mapping) -> Optional[Path]:
     return ck if ck.exists() else None
 
 
-def read_latest_manifest(checkpoint_dir: Path) -> Optional[dict]:
-    """Newest checkpoint's manifest under ``checkpoint_dir`` (None when no
-    checkpoint, no manifest item, or orbax unavailable)."""
+def read_latest_manifest(checkpoint_dir: Path, *,
+                         integrity: Any = None,
+                         trail: Optional[dict] = None) -> Optional[dict]:
+    """Newest VERIFIED checkpoint's manifest under ``checkpoint_dir`` (None
+    when no checkpoint, no manifest item, or orbax unavailable).
+
+    ``integrity`` (an ``IntegrityConfig``; default: knob defaults, i.e.
+    verification ON) selects the step the manifest is read from: the newest
+    step whose integrity sidecar verifies — corrupt newer steps are
+    quarantined here, at DISCOVERY time, so the replan keys off the step the
+    trainer will actually restore and every later ``latest_step`` agrees.
+    When every retained step is corrupt, the curated
+    ``CheckpointIntegrityError`` PROPAGATES (an un-resumable store must stop
+    the launch loudly, not silently start a fresh run).
+
+    ``trail`` (a mutable dict) receives the discovery checkpointer's
+    integrity trail — verified step, walk-back count, quarantined steps —
+    so the caller can persist what happened here into ``run_summary.json``
+    (the trainer's own restore then sees an already-cleaned chain and would
+    otherwise report a walk-back of zero)."""
+    from neuronx_distributed_training_tpu.checkpoint import (
+        CheckpointIntegrityError,
+    )
+
     try:
         from neuronx_distributed_training_tpu.checkpoint import (
             CheckpointConfig,
             Checkpointer,
+            IntegrityConfig,
         )
 
+        icfg = integrity if integrity is not None else IntegrityConfig()
         ck = Checkpointer(
             CheckpointConfig(dir=str(checkpoint_dir), save_top_k=0,
-                             async_save=False))
+                             async_save=False, integrity=icfg))
         try:
-            return ck.read_manifest()
+            step = (ck.verified_latest_step()
+                    if icfg.enabled and icfg.verify_restore
+                    else ck.latest_step())
+            return ck.read_manifest(step) if step is not None else None
         finally:
+            if trail is not None and ck.integrity_trail:
+                trail.update(ck.integrity_trail)
             ck.close()
+    except CheckpointIntegrityError:
+        raise
     except Exception as e:  # noqa: BLE001 — discovery must never kill a launch
         logger.warning("manifest discovery under %s failed: %s",
                        checkpoint_dir, e)
@@ -287,6 +317,10 @@ class ReplanResult:
     record: Optional[dict] = None
     manifest: Optional[dict] = None
     checkpoint_dir: Optional[Path] = None
+    # the discovery checkpointer's integrity trail (verified step, walk-back
+    # count, quarantined steps) — non-None when discovery verification ran;
+    # the trainer merges it into run_summary.json's integrity section
+    integrity_trail: Optional[dict] = None
 
     @property
     def replanned(self) -> bool:
@@ -311,12 +345,25 @@ def maybe_replan(cfg: Any, chips: int, *,
     ck_dir = discover_checkpoint_dir(cfg)
     if ck_dir is None:
         return ReplanResult(cfg=cfg)
-    manifest = read_latest_manifest(ck_dir)
+    # manifest discovery verifies integrity and walks back: a corrupt newest
+    # step is quarantined HERE, so the replanned layout keys off the step
+    # the trainer will actually restore (docs/elasticity.md)
+    from neuronx_distributed_training_tpu.checkpoint.integrity import (
+        parse_checkpoint_block,
+    )
+
+    icfg = parse_checkpoint_block(
+        dict(cfg.get("exp_manager", {}) or {}).get("checkpoint"))
+    itrail: dict[str, Any] = {}
+    manifest = read_latest_manifest(ck_dir, integrity=icfg, trail=itrail)
+    itrail_or_none = itrail or None
     if manifest is None:
-        return ReplanResult(cfg=cfg, checkpoint_dir=ck_dir)
+        return ReplanResult(cfg=cfg, checkpoint_dir=ck_dir,
+                            integrity_trail=itrail_or_none)
     old_world = int(manifest.get("world_size", 0) or 0)
     if old_world == int(chips) and not force:
-        return ReplanResult(cfg=cfg, manifest=manifest, checkpoint_dir=ck_dir)
+        return ReplanResult(cfg=cfg, manifest=manifest, checkpoint_dir=ck_dir,
+                            integrity_trail=itrail_or_none)
 
     # model identity: a different model cannot "resume", replan or not
     from neuronx_distributed_training_tpu.autotune import plan_config
@@ -392,7 +439,8 @@ def maybe_replan(cfg: Any, chips: int, *,
                 "%s on %d chips", _plan_str(fb), chips,
             )
             return ReplanResult(cfg=cfg, record=record, manifest=manifest,
-                                checkpoint_dir=ck_dir)
+                                checkpoint_dir=ck_dir,
+                                integrity_trail=itrail_or_none)
         old_plan = dict(manifest.get("plan", {}) or {})
         raise ElasticResumeError(
             f"no plan for {chips} chips keeps the checkpoint's layer layout "
@@ -424,7 +472,7 @@ def maybe_replan(cfg: Any, chips: int, *,
         chosen.plan.describe(), dt, len(skipped),
     )
     return ReplanResult(cfg=new_cfg, record=record, manifest=manifest,
-                        checkpoint_dir=ck_dir)
+                        checkpoint_dir=ck_dir, integrity_trail=itrail_or_none)
 
 
 def _declared_plan_fallback(cfg: Any, manifest: Mapping,

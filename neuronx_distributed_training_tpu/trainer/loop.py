@@ -31,6 +31,7 @@ from neuronx_distributed_training_tpu.checkpoint import (
 from neuronx_distributed_training_tpu.config.loader import ConfigDict, batch_schedule
 from neuronx_distributed_training_tpu.data import (
     DataModule,
+    DataStallError,
     PrefetchIterator,
     SyntheticDataModule,
 )
@@ -245,6 +246,11 @@ class Trainer:
     # checkpoint manifest; fit() accounts its wall time as a "replan" span
     # and persists it in run_summary.json's elastic section
     replan_record: Optional[dict] = None
+    # integrity trail of the DISCOVERY-time verification (trainer.elastic.
+    # maybe_replan walked back / quarantined before this trainer existed);
+    # merged with the checkpointer's own restore trail into the
+    # run_summary.json integrity section at teardown
+    discovery_integrity_trail: Optional[dict] = None
     # preemption drill hook (trainer.elastic.FaultInjector): fires at the
     # step/save/restore injection points; None outside drills
     fault_injector: Optional[Any] = None
@@ -1234,7 +1240,8 @@ class Trainer:
             # thread-safe.  AFTER resume: the sampler's consumed_samples
             # must be restored before the first fetch.
             batches = PrefetchIterator(
-                self.data_module.sharded_batches(self.mesh))
+                self.data_module.sharded_batches(self.mesh),
+                timeout_seconds=hc.data_wait_timeout_seconds)
             log_every = max(1, int(self.exp.log_every_n_steps))
             census_pending = tel.compile_census
             with self.mesh, shd.use_mesh(self.mesh):
@@ -1264,7 +1271,23 @@ class Trainer:
                         # out of maybe_fire instead)
                         _request_stop("injected preemption notice")
                     with spans.span("data_wait"):
-                        batch = next(batches)
+                        try:
+                            batch = next(batches)
+                        except DataStallError:
+                            # data-stall watchdog (telemetry.health.
+                            # data_wait_timeout_seconds): feed the existing
+                            # hang-watchdog bundle path — thread stacks + a
+                            # device-safe forensic bundle — then let the
+                            # curated error propagate instead of freezing
+                            if monitor is not None:
+                                from neuronx_distributed_training_tpu.telemetry.flight_recorder import (  # noqa: E501
+                                    _all_thread_stacks,
+                                )
+
+                                monitor.dump_hang(
+                                    self.step, "data_wait",
+                                    _all_thread_stacks())
+                            raise
                     key = jax.random.fold_in(
                         jax.random.PRNGKey(STEP_KEY_SEED), self.step)
                     if census_pending:
@@ -1456,6 +1479,17 @@ class Trainer:
                 self.exp.write_run_summary(summary)
             except Exception as e:  # noqa: BLE001 — teardown must finish
                 logger.warning("goodput summary write failed: %s", e)
+        itrail = self._merged_integrity_trail()
+        if itrail:
+            # the integrity trail (docs/elasticity.md "Integrity &
+            # walk-back"): which step actually verified, how many corrupt
+            # steps the restore walked past (including at discovery time,
+            # before this trainer existed), what got quarantined, and what
+            # the post-commit audit cost — metrics_report.py renders it
+            try:
+                self.exp.write_run_summary({"integrity": itrail})
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("integrity summary write failed: %s", e)
         if resumed or self.replan_record is not None \
                 or stop_requested["reason"] is not None:
             # the elastic trail (docs/elasticity.md): what the restart
@@ -1476,6 +1510,33 @@ class Trainer:
             except Exception as e:  # noqa: BLE001 — teardown must finish
                 logger.warning("elastic summary write failed: %s", e)
         self.exp.close()
+
+    def _merged_integrity_trail(self) -> dict:
+        """Union of the discovery-time integrity trail (the replanner's
+        walk-back, ``discovery_integrity_trail``) and the checkpointer's own
+        restore/audit trail: walk-back counts add, quarantined steps union,
+        the restore's verified step wins (it is the step actually used)."""
+        disc = dict(self.discovery_integrity_trail or {})
+        # getattr: fit() also runs against checkpointer test doubles
+        own = dict(getattr(self.checkpointer, "integrity_trail", None) or {})
+        if not disc:
+            return own
+        if not own:
+            return disc
+        merged = {**disc, **own}
+        merged["walk_back_count"] = (int(disc.get("walk_back_count", 0))
+                                     + int(own.get("walk_back_count", 0)))
+        q = list(disc.get("quarantined_steps") or [])
+        for s in own.get("quarantined_steps") or []:
+            if s not in q:
+                q.append(s)
+        merged["quarantined_steps"] = q
+        merged["verify_seconds"] = round(
+            float(disc.get("verify_seconds", 0.0))
+            + float(own.get("verify_seconds", 0.0)), 3)
+        if disc.get("legacy_restore") or own.get("legacy_restore"):
+            merged["legacy_restore"] = True
+        return merged
 
     def _compile_census(self, batch, key, spans) -> None:
         """First-compile census (telemetry.compile_census): AOT lower+compile

@@ -1,0 +1,727 @@
+"""Checkpoint integrity: digest sidecars, verified restore with walk-back,
+quarantine, the post-commit save audit, corruption drills, and the
+data-stall watchdog (docs/elasticity.md "Integrity & walk-back").
+
+The corrupt-restore matrix is the heart: every injection kind (byte-flip /
+truncate / delete-item / stale-sidecar) × (same-world resume, dp-change
+elastic resume) must end in quarantine + walk-back + continuity — no human
+intervention, no crash loop.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from neuronx_distributed_training_tpu.checkpoint import (
+    CheckpointConfig,
+    Checkpointer,
+    CheckpointIntegrityError,
+    IntegrityConfig,
+    TrainState,
+    inject_corruption,
+)
+from neuronx_distributed_training_tpu.checkpoint import integrity as I
+from neuronx_distributed_training_tpu.config.loader import load_config
+from neuronx_distributed_training_tpu.data.loader import (
+    DataStallError,
+    PrefetchIterator,
+)
+
+from elastic_drill import read_losses, run_corruption_drill, tiny_llama_config
+
+
+# ---------------------------------------------------------------------------
+# knob block
+# ---------------------------------------------------------------------------
+
+
+class TestIntegrityConfig:
+    def test_defaults(self):
+        ic = IntegrityConfig.from_config(None)
+        assert ic.enabled and ic.verify_restore and ic.quarantine
+        assert not ic.audit
+        assert ic.audit_deadline_seconds == 120.0
+
+    def test_bare_bool_toggles_enabled(self):
+        assert IntegrityConfig.from_config(True).enabled
+        assert not IntegrityConfig.from_config(False).enabled
+
+    def test_unknown_key_has_did_you_mean(self):
+        with pytest.raises(ValueError, match="quarantine"):
+            IntegrityConfig.from_config({"quarantene": True})
+
+    def test_ill_typed_rejected(self):
+        with pytest.raises(ValueError, match="boolean"):
+            IntegrityConfig.from_config({"audit": "yes"})
+        with pytest.raises(ValueError, match="number"):
+            IntegrityConfig.from_config({"audit_deadline_seconds": "fast"})
+        with pytest.raises(ValueError, match=">= 0"):
+            IntegrityConfig.from_config({"audit_deadline_seconds": -1})
+
+    def test_checkpoint_block_unknown_key(self):
+        with pytest.raises(ValueError, match="integrity"):
+            I.parse_checkpoint_block({"integrety": {}})
+
+    def test_loader_validates_the_block(self):
+        raw = {
+            "trainer": {"max_steps": 1},
+            "exp_manager": {"checkpoint": {"integrity": {"enabeld": True}}},
+        }
+        with pytest.raises(ValueError, match="enabled"):
+            load_config(raw)
+
+    def test_config_flows_into_checkpoint_config(self):
+        cfg = CheckpointConfig.from_config({
+            "exp_manager": {"checkpoint": {"integrity": {
+                "audit": True, "audit_deadline_seconds": 7}}},
+        })
+        assert cfg.integrity.audit
+        assert cfg.integrity.audit_deadline_seconds == 7.0
+
+
+# ---------------------------------------------------------------------------
+# sidecar digests
+# ---------------------------------------------------------------------------
+
+
+def _trees(scale=1.0):
+    params = {"w": jnp.full((8, 4), scale, jnp.float32),
+              "b": jnp.arange(4, dtype=jnp.bfloat16)}
+    opt = {"mu": jax.tree_util.tree_map(jnp.zeros_like, params),
+           "master": jax.tree_util.tree_map(
+               lambda x: x.astype(jnp.float32), params),
+           "step": jnp.asarray(3, jnp.int32)}
+    return params, opt
+
+
+class TestSidecar:
+    def test_deterministic_and_grouped(self):
+        p, o = _trees()
+        s1 = I.build_sidecar(step=3, params=p, opt_state=o,
+                             meta={"step": 3}, manifest={"world_size": 8})
+        s2 = I.build_sidecar(step=3, params=p, opt_state=o,
+                             meta={"step": 3}, manifest={"world_size": 8})
+        assert s1 == s2
+        assert s1["content"] is True
+        # opt_state splits per top-level key; params stays one group
+        assert {"params", "opt_state/mu", "opt_state/master",
+                "opt_state/step"} <= set(s1["groups"])
+        assert all(v["leaves"] >= 1 and len(v["digest"]) == 32
+                   for v in s1["groups"].values())
+
+    def test_value_change_flips_only_its_group(self):
+        p, o = _trees()
+        base = I.build_sidecar(step=3, params=p, opt_state=o, meta={})
+        o2 = dict(o, mu=jax.tree_util.tree_map(lambda x: x + 1, o["mu"]))
+        changed = I.build_sidecar(step=3, params=p, opt_state=o2, meta={})
+        assert (changed["groups"]["opt_state/mu"]["digest"]
+                != base["groups"]["opt_state/mu"]["digest"])
+        assert (changed["groups"]["params"]["digest"]
+                == base["groups"]["params"]["digest"])
+        assert (changed["groups"]["opt_state/master"]["digest"]
+                == base["groups"]["opt_state/master"]["digest"])
+
+    def test_json_digest_normalizes(self):
+        assert I.json_digest({"a": (1, 2)}) == I.json_digest({"a": [1, 2]})
+        assert I.json_digest({"a": 1}) != I.json_digest({"a": 2})
+
+    def test_structure_summary_carries_shapes_dtypes(self):
+        p, o = _trees()
+        s = I.build_sidecar(step=1, params=p, opt_state=o, meta={})
+        w = s["tree"]["params"]["['w']"]
+        assert w == {"dtype": "float32", "shape": [8, 4]}
+        assert s["tree"]["params"]["['b']"]["dtype"] == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# save → verify round trip
+# ---------------------------------------------------------------------------
+
+
+def _save_steps(tmp_path, steps=(1, 2), *, integrity=None, manifest=True,
+                top_k=5, **cfg_over):
+    cfg = CheckpointConfig(
+        dir=tmp_path, async_save=False, save_top_k=top_k,
+        integrity=integrity if integrity is not None else IntegrityConfig(),
+        **cfg_over)
+    ck = Checkpointer(cfg)
+    for s in steps:
+        p, o = _trees(scale=float(s))
+        ck.save(TrainState(p, o, s, s * 8),
+                manifest=({"world_size": 8, "step": s, "format": 1,
+                           "plan": {"pp": 1, "vp": 1}} if manifest else None))
+    ck.wait()
+    return ck
+
+
+class TestVerifyRoundTrip:
+    def test_clean_save_verifies_ok(self, tmp_path):
+        with _save_steps(tmp_path) as ck:
+            v = ck.verify_step(2)
+            assert v.status == "ok" and not v.failures
+            assert v.groups_checked >= 5  # meta+manifest+params+2 opt groups
+            assert ck.verified_latest_step() == 2
+            assert ck.integrity_trail["verified_step"] == 2
+            assert ck.integrity_trail["walk_back_count"] == 0
+
+    def test_save_bf16_digests_the_cast_bytes(self, tmp_path):
+        with _save_steps(tmp_path, save_bf16=True) as ck:
+            assert ck.verify_step(2).status == "ok"
+
+    def test_legacy_checkpoint_restores_with_warning(self, tmp_path, caplog):
+        ck = _save_steps(tmp_path,
+                         integrity=IntegrityConfig(enabled=False))
+        p, o = _trees()
+        assert ck.verify_step(2).status == "legacy"
+        with caplog.at_level(logging.WARNING):
+            restored = ck.restore(p, o, verify=True)
+        assert restored.step == 2
+        assert "legacy" in caplog.text.lower()
+        assert ck.integrity_trail.get("legacy_restore") is True
+        ck.close()
+
+    def test_disabled_integrity_saves_no_sidecar(self, tmp_path):
+        ck = _save_steps(tmp_path, integrity=IntegrityConfig(enabled=False))
+        assert not (ck.directory / "2" / I.INTEGRITY_ITEM).exists()
+        ck.close()
+
+    def test_explicit_corrupt_step_raises(self, tmp_path):
+        ck = _save_steps(tmp_path)
+        inject_corruption(ck.directory, 2, "byte_flip")
+        p, o = _trees()
+        with pytest.raises(CheckpointIntegrityError, match="step 2"):
+            ck.restore(p, o, step=2)
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# the corrupt-restore matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", I.CORRUPTION_KINDS)
+class TestCorruptRestoreMatrix:
+    def test_walk_back_quarantine_and_restore(self, tmp_path, kind):
+        ck = _save_steps(tmp_path, steps=(1, 2, 3))
+        what = inject_corruption(ck.directory, 3, kind)
+        assert kind.split("_")[0] in what
+        v = ck.verify_step(3)
+        assert v.status == "corrupt", (kind, v)
+        assert v.failures
+        # walk-back: newest good step wins, the corpse is quarantined
+        assert ck.verified_latest_step() == 2
+        trail = ck.integrity_trail
+        assert trail["verified_step"] == 2
+        assert trail["walk_back_count"] == 1
+        assert trail["quarantined_steps"] == [3]
+        assert [e["step"] for e in I.read_ledger(ck.directory)] == [3]
+        qdirs = [p.name for p in ck.directory.iterdir()
+                 if I.parse_quarantine_name(p.name) == 3]
+        assert len(qdirs) == 1
+        # discovery agrees: orbax no longer sees step 3
+        assert ck.latest_step() == 2
+        # restore lands on the walked-back state
+        p, o = _trees()
+        restored = ck.restore(p, o)
+        assert restored.step == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]),
+            np.full((8, 4), 2.0, np.float32))
+        ck.close()
+
+
+class TestGoneAndUnquarantined:
+    def test_gone_step_is_skipped_not_restored(self, tmp_path, monkeypatch):
+        """A step whose dir vanished between the listing and the read
+        (concurrent quarantine/retention on another actor) must be walked
+        past, not returned as the restore target."""
+        ck = _save_steps(tmp_path, steps=(1, 2))
+        real = ck.verify_step
+        monkeypatch.setattr(
+            ck, "verify_step",
+            lambda s: (I.StepVerification(step=s, status="gone")
+                       if s == 2 else real(s)))
+        assert ck.verified_latest_step() == 1
+        trail = ck.integrity_trail
+        assert trail["verified_step"] == 1
+        assert trail["walk_back_count"] == 0  # gone is not a corrupt skip
+        assert trail["quarantined_steps"] == []
+        ck.close()
+
+    def test_all_gone_returns_none(self, tmp_path, monkeypatch):
+        ck = _save_steps(tmp_path, steps=(1, 2))
+        monkeypatch.setattr(
+            ck, "verify_step",
+            lambda s: I.StepVerification(step=s, status="gone"))
+        assert ck.verified_latest_step() is None
+        ck.close()
+
+    def test_quarantine_off_reports_honestly(self, tmp_path):
+        """quarantine: false walks past a corrupt step WITHOUT renaming or
+        ledgering it — and the trail must say so, not claim a quarantine."""
+        ck = _save_steps(
+            tmp_path, steps=(1, 2),
+            integrity=IntegrityConfig(quarantine=False))
+        inject_corruption(ck.directory, 2, "byte_flip")
+        assert ck.verified_latest_step() == 1
+        trail = ck.integrity_trail
+        assert trail["quarantined_steps"] == []
+        assert trail["corrupt_steps_unquarantined"] == [2]
+        assert (ck.directory / "2").exists()  # still live on disk
+        assert I.read_ledger(ck.directory) == []
+        ck.close()
+
+    def test_mid_read_deletion_yields_gone_not_corrupt(self, tmp_path):
+        """Retention deleting a step while the (audit) read is in flight is
+        a race, not corruption — no false quarantine/ledger entry."""
+        import shutil
+
+        ck = _save_steps(tmp_path, steps=(1,))
+        ck.close()
+
+        class VanishingReader:
+            def restore(self, step, args=None):
+                shutil.rmtree(tmp_path / "1", ignore_errors=True)
+                raise RuntimeError("read hit a half-deleted dir")
+
+        v = I.verify_step(tmp_path, 1, mgr=VanishingReader())
+        assert v.status == "gone"
+        assert v.failures == []
+
+
+class TestAllCorrupt:
+    def test_curated_error_when_nothing_verifies(self, tmp_path):
+        ck = _save_steps(tmp_path, steps=(1, 2))
+        inject_corruption(ck.directory, 2, "byte_flip")
+        inject_corruption(ck.directory, 1, "delete_item", item="opt_state")
+        with pytest.raises(CheckpointIntegrityError) as ei:
+            ck.verified_latest_step()
+        msg = str(ei.value)
+        assert "every retained checkpoint" in msg
+        assert "step 2" in msg and "step 1" in msg
+        assert I.LEDGER_NAME in msg
+        assert len(ei.value.verdicts) == 2
+        # both quarantined; nothing left for orbax to discover
+        assert ck.latest_step() is None
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# quarantine naming round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantineNaming:
+    def test_parse_round_trip(self):
+        name = I.quarantine_name(42, "params: content digest mismatch")
+        assert name.startswith(I.QUARANTINE_PREFIX)
+        assert I.parse_quarantine_name(name) == 42
+        assert I.parse_quarantine_name("42") is None
+        assert I.parse_quarantine_name("version_3") is None
+        assert I.parse_quarantine_name("quarantined.x.y") is None
+
+    def test_quarantined_dirs_invisible_to_discovery(self, tmp_path):
+        ck = _save_steps(tmp_path, steps=(1, 2))
+        inject_corruption(ck.directory, 2, "truncate")
+        assert ck.verified_latest_step() == 1
+        ck.close()
+        # a FRESH manager (new process) sees only the good step, and the
+        # ledger file + quarantine dirs don't break step discovery
+        ck2 = Checkpointer(CheckpointConfig(dir=tmp_path, async_save=False))
+        assert ck2.latest_step() == 1
+        ck2.close()
+
+    def test_exp_manager_version_parse_unaffected(self, tmp_path):
+        from neuronx_distributed_training_tpu.trainer.exp_manager import (
+            latest_version,
+        )
+
+        (tmp_path / "version_0" / "checkpoints").mkdir(parents=True)
+        (tmp_path / "version_1" / "checkpoints").mkdir(parents=True)
+        q = tmp_path / "version_1" / "checkpoints" / I.quarantine_name(9, "x")
+        q.mkdir()
+        (tmp_path / "version_1" / "checkpoints" / I.LEDGER_NAME).write_text(
+            '{"entries": []}\n')
+        assert latest_version(tmp_path) == 1
+
+
+# ---------------------------------------------------------------------------
+# post-commit save audit
+# ---------------------------------------------------------------------------
+
+
+class TestSaveAudit:
+    def test_audit_detects_post_commit_corruption(self, tmp_path):
+        ic = IntegrityConfig(audit=True, audit_deadline_seconds=30.0)
+        ck = _save_steps(tmp_path, steps=(1, 2), integrity=ic)
+        # bitrot lands AFTER commit; wait() kicks the audit, close() drains
+        # + applies the verdicts
+        inject_corruption(ck.directory, 2, "byte_flip")
+        ck.wait()
+        ck.close()
+        trail = ck.integrity_trail
+        assert trail["audit"]["audited"] == 2
+        assert trail["audit"]["failed"] == 1
+        assert trail["audit"]["seconds"] > 0
+        assert 2 in trail.get("audit_quarantined", [])
+        assert [e["step"] for e in I.read_ledger(tmp_path)] == [2]
+
+    def test_clean_audit_quarantines_nothing(self, tmp_path):
+        ic = IntegrityConfig(audit=True)
+        ck = _save_steps(tmp_path, steps=(1, 2), integrity=ic)
+        ck.close()
+        trail = ck.integrity_trail
+        assert trail["audit"] == {"audited": 2, "failed": 0,
+                                  "seconds": trail["audit"]["seconds"],
+                                  "incomplete": 0}
+        assert trail["quarantined_steps"] == []
+
+    def test_emergency_save_during_inflight_audit_no_deadlock(self, tmp_path):
+        """Satellite: a SIGTERM grace-window emergency save landing while the
+        previous step's audit is still RUNNING must neither deadlock nor
+        skip a finished audit-failure quarantine — the verdict is
+        snapshotted at the boundary like the stop decision."""
+        release = threading.Event()
+        finished_first = threading.Event()
+        real_verify = I.verify_step
+
+        def slow_verify(directory, step):
+            v = real_verify(directory, step)
+            if int(step) == 1:
+                finished_first.set()
+                release.wait(timeout=30)
+            return v
+
+        ic = IntegrityConfig(audit=True, audit_deadline_seconds=5.0)
+        ck = Checkpointer(CheckpointConfig(dir=tmp_path, async_save=False,
+                                           integrity=ic))
+        ck._auditor._verify = slow_verify
+        p, o = _trees(1.0)
+        ck.save(TrainState(p, o, 1, 8))
+        ck.wait()  # kicks the (slow) audit of step 1
+        assert finished_first.wait(timeout=10)
+        # the emergency save: drained, deadline-bounded — the audit thread
+        # is parked inside its job, and this must return promptly anyway
+        t0 = time.monotonic()
+        p2, o2 = _trees(2.0)
+        ck.save_with_retry(TrainState(p2, o2, 2, 16), force=True, drain=True,
+                           deadline=time.monotonic() + 10.0)
+        assert time.monotonic() - t0 < 8.0, "emergency save blocked on audit"
+        release.set()
+        ck.close()
+        # both audits completed by the bounded teardown drain
+        assert ck.integrity_trail["audit"]["audited"] == 2
+        assert ck.integrity_trail["audit"]["failed"] == 0
+
+    def test_completed_failure_verdict_applied_at_emergency_boundary(
+            self, tmp_path):
+        ic = IntegrityConfig(audit=True)
+        ck = Checkpointer(CheckpointConfig(dir=tmp_path, async_save=False,
+                                           integrity=ic))
+        p, o = _trees(1.0)
+        ck.save(TrainState(p, o, 1, 8))
+        # corrupt AFTER commit, then let the audit finish before the
+        # emergency save hits the boundary
+        inject_corruption(ck.directory, 1, "byte_flip")
+        ck._mgr.wait_until_finished()
+        ck._kick_audits()
+        assert ck._auditor.drain(timeout=20)
+        # emergency save at the boundary: the snapshot applies the failed
+        # verdict (quarantine) before the new save commits
+        p2, o2 = _trees(2.0)
+        ck.save_with_retry(TrainState(p2, o2, 2, 16), force=True, drain=True)
+        assert 1 in ck.integrity_trail.get("audit_quarantined", [])
+        assert ck.latest_step() == 2
+        ck.close()
+
+    def test_drain_deadline_counts_incomplete(self, tmp_path):
+        hang = threading.Event()
+
+        def never_done(directory, step):
+            hang.wait(timeout=60)
+            return I.StepVerification(step=step, status="ok")
+
+        aud = I.SaveAuditor(tmp_path, verify_fn=never_done)
+        aud.schedule(1)
+        t0 = time.monotonic()
+        assert not aud.drain(timeout=0.2)
+        assert time.monotonic() - t0 < 5.0
+        assert aud.stats.incomplete == 1
+        hang.set()
+
+
+# ---------------------------------------------------------------------------
+# elastic discovery + replan key off the verified step
+# ---------------------------------------------------------------------------
+
+
+def _tiny_raw(tmp_path, **over):
+    raw = tiny_llama_config(tmp_path, max_steps=4, save_every=2)
+    raw.update(over)
+    return raw
+
+
+class TestElasticDiscovery:
+    def test_manifest_reads_from_verified_step(self, tmp_path):
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            read_latest_manifest,
+        )
+
+        ck = _save_steps(tmp_path, steps=(1, 2))
+        ck.close()
+        inject_corruption(tmp_path, 2, "stale_sidecar")
+        trail: dict = {}
+        m = read_latest_manifest(tmp_path, trail=trail)
+        assert m is not None and m["step"] == 1
+        assert trail["verified_step"] == 1
+        assert trail["walk_back_count"] == 1
+        assert trail["quarantined_steps"] == [2]
+
+    def test_all_corrupt_discovery_raises_not_silently_fresh(self, tmp_path):
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            read_latest_manifest,
+        )
+
+        ck = _save_steps(tmp_path, steps=(1,))
+        ck.close()
+        inject_corruption(tmp_path, 1, "truncate")
+        with pytest.raises(CheckpointIntegrityError):
+            read_latest_manifest(tmp_path)
+
+    def test_legacy_checkpoint_discovery_warns_not_crashes(self, tmp_path,
+                                                           caplog):
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            read_latest_manifest,
+        )
+
+        ck = _save_steps(tmp_path, steps=(1,),
+                         integrity=IntegrityConfig(enabled=False))
+        ck.close()
+        trail: dict = {}
+        with caplog.at_level(logging.WARNING):
+            m = read_latest_manifest(tmp_path, trail=trail)
+        assert m is not None and m["step"] == 1
+        assert trail.get("legacy_restore") is True
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fit() resumes past a corrupt newest step
+# ---------------------------------------------------------------------------
+
+
+class TestFitWalkBack:
+    def test_same_world_resume_walks_back_bitwise(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            discover_checkpoint_dir,
+        )
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        raw = tiny_llama_config(tmp_path, max_steps=6, save_every=2)
+        cfg = load_config(raw)
+        t1 = Trainer.from_config(cfg, devices=devices8[:4])
+        t1.fit()
+        ck_dir = discover_checkpoint_dir(cfg)
+        steps = sorted(int(p.name) for p in ck_dir.iterdir()
+                       if p.name.isdigit())
+        newest, prior = steps[-1], steps[-2]
+        inject_corruption(ck_dir, newest, "byte_flip")
+        # auto-resume: same world, no replan — maybe_resume's verified
+        # restore must quarantine the corpse and walk back
+        t2 = Trainer.from_config(load_config(raw), devices=devices8[:4])
+        metrics = t2.fit()
+        assert metrics and np.isfinite(metrics["loss"])
+        run_dir = ck_dir.parent
+        summary = json.loads((run_dir / "run_summary.json").read_text())
+        trail = summary["integrity"]
+        assert trail["verified_step"] == prior
+        assert trail["walk_back_count"] == 1
+        assert newest in trail["quarantined_steps"]
+        # bitwise continuity: retrained steps equal the first run's losses
+        losses = read_losses(run_dir)
+        assert max(losses) == 6
+
+    def test_corruption_drill_cross_dp(self, tmp_path, devices8):
+        report = run_corruption_drill(
+            tmp_path, kind="stale_sidecar", world=4, resume_world=2,
+            total_steps=4, save_every=2)
+        assert report["ok"]
+        assert report["walked_back"] == 1
+        assert report["resume_step"] == 2
+        assert report["replanned"]
+
+
+# ---------------------------------------------------------------------------
+# data-stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestDataStallWatchdog:
+    def test_hung_source_raises_curated_error(self):
+        hang = threading.Event()
+
+        def hung():
+            hang.wait(timeout=60)
+            yield {"x": 1}
+
+        it = PrefetchIterator(hung(), timeout_seconds=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(DataStallError, match="data_wait_timeout_seconds"):
+            next(it)
+        assert time.monotonic() - t0 < 5.0
+        hang.set()
+        it.close()
+
+    def test_slow_but_alive_source_never_trips(self):
+        def slow():
+            for i in range(3):
+                time.sleep(0.05)
+                yield i
+
+        it = PrefetchIterator(slow(), timeout_seconds=2.0)
+        assert list(it) == [0, 1, 2]
+        it.close()
+
+    def test_timeout_off_by_default(self):
+        it = PrefetchIterator(iter([1]), timeout_seconds=0.0)
+        assert it._timeout is None
+        assert next(it) == 1
+        it.close()
+
+    def test_health_knob_validated(self):
+        from neuronx_distributed_training_tpu.telemetry.health import (
+            HealthConfig,
+        )
+
+        hc = HealthConfig.from_config({"data_wait_timeout_seconds": 30})
+        assert hc.data_wait_timeout_seconds == 30.0
+        with pytest.raises(ValueError, match=">= 0"):
+            HealthConfig.from_config({"data_wait_timeout_seconds": -1})
+        with pytest.raises(ValueError, match="data_wait_timeout_seconds"):
+            HealthConfig.from_config({"data_wait_timeout_secs": 5})
+
+    def test_loop_dumps_hang_bundle_then_raises(self, tmp_path, devices8):
+        """The fit loop feeds the existing HangWatchdog bundle path on a
+        data stall: hang bundle on disk, curated error out."""
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        raw = tiny_llama_config(tmp_path, max_steps=4, save_every=100)
+        raw["exp_manager"]["telemetry"]["health"] = {
+            "enabled": True, "data_wait_timeout_seconds": 0.5}
+        trainer = Trainer.from_config(load_config(raw), devices=devices8[:4])
+
+        class HungModule:
+            global_batch_size = trainer.data_module.global_batch_size
+            sampler = trainer.data_module.sampler
+            seq_len = 32
+
+            def sharded_batches(self, mesh):
+                threading.Event().wait(timeout=60)
+                yield {}
+
+        trainer.data_module = HungModule()
+        with pytest.raises(DataStallError):
+            trainer.fit()
+        bundles = list(trainer.exp.log_dir.glob("hang_*"))
+        assert bundles, "no hang bundle written on data stall"
+
+
+# ---------------------------------------------------------------------------
+# offline CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCkptVerifyCLI:
+    def test_report_json_and_exit_codes(self, tmp_path, capsys):
+        import ckpt_verify
+
+        ck = _save_steps(tmp_path / "checkpoints", steps=(1, 2))
+        ck.close()
+        assert ckpt_verify.main([str(tmp_path), "--json", "-"]) == 0
+        payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert payload["ok"] and payload["corrupt_steps"] == []
+        assert [s["status"] for s in payload["steps"]] == ["ok", "ok"]
+
+        inject_corruption(tmp_path / "checkpoints", 2, "byte_flip")
+        assert ckpt_verify.main([str(tmp_path), "--json", "-"]) == 1
+        payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert payload["corrupt_steps"] == [2]
+        assert payload["quarantined"] == []  # report-only by default
+
+    def test_quarantine_flag_applies_the_ledger(self, tmp_path, capsys):
+        import ckpt_verify
+
+        ck = _save_steps(tmp_path / "checkpoints", steps=(1, 2))
+        ck.close()
+        inject_corruption(tmp_path / "checkpoints", 2, "truncate")
+        assert ckpt_verify.main(
+            [str(tmp_path), "--quarantine", "--json", "-"]) == 1
+        payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert payload["quarantined"] == [2]
+        assert payload["ledger_entries"] == 1
+        # the next resume walks straight to the good step
+        ck2 = Checkpointer(CheckpointConfig(dir=tmp_path / "checkpoints",
+                                            async_save=False))
+        assert ck2.latest_step() == 1
+        ck2.close()
+
+    def test_single_step_and_missing(self, tmp_path, capsys):
+        import ckpt_verify
+
+        ck = _save_steps(tmp_path / "checkpoints", steps=(1,))
+        ck.close()
+        assert ckpt_verify.main(
+            [str(tmp_path), "--step", "1", "--json", "-"]) == 0
+        capsys.readouterr()
+        assert ckpt_verify.main(
+            [str(tmp_path), "--step", "9", "--json", "-"]) == 1
+        payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "not found" in payload["error"]
+
+    def test_no_checkpoints_is_an_error(self, tmp_path, capsys):
+        import ckpt_verify
+
+        assert ckpt_verify.main(
+            [str(tmp_path / "nowhere"), "--json", "-"]) == 1
+
+    def test_file_path_is_a_curated_error_not_a_traceback(self, tmp_path,
+                                                          capsys):
+        import ckpt_verify
+
+        f = tmp_path / "run_summary.json"
+        f.write_text("{}")
+        assert ckpt_verify.main([str(f), "--json", "-"]) == 1
+        payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "no checkpoint directory" in payload["error"]
+
+
+# ---------------------------------------------------------------------------
+# corruption injection itself
+# ---------------------------------------------------------------------------
+
+
+class TestInjection:
+    def test_unknown_kind_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="byte_flip"):
+            inject_corruption(tmp_path, 1, "bit_rot")
+
+    def test_missing_step_rejected(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            inject_corruption(tmp_path, 7, "byte_flip")
+
+    def test_stale_sidecar_without_older_step_tampers(self, tmp_path):
+        ck = _save_steps(tmp_path, steps=(1,))
+        what = inject_corruption(tmp_path, 1, "stale_sidecar")
+        assert "zeroed" in what
+        assert ck.verify_step(1).status == "corrupt"
+        ck.close()

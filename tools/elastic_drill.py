@@ -138,13 +138,15 @@ def run_segment(raw_cfg: dict, devices: list, *,
     from neuronx_distributed_training_tpu.trainer.loop import Trainer
 
     cfg = load_config(raw_cfg)
-    record = None
+    record, itrail = None, None
     if replan_world is not None:
         result = maybe_replan(cfg, int(replan_world))
-        cfg, record = result.cfg, result.record
+        cfg, record, itrail = result.cfg, result.record, result.integrity_trail
     trainer = Trainer.from_config(cfg, devices=list(devices))
     if record is not None:
         trainer.replan_record = record
+    if itrail is not None:
+        trainer.discovery_integrity_trail = itrail
     if fault is not None:
         trainer.fault_injector = fault
     killed, metrics = False, None
@@ -343,13 +345,155 @@ def run_drill(workdir: str | Path, *, at_step: int = 3, phase: str = "step",
     return report
 
 
+def run_corruption_drill(workdir: str | Path, *, kind: str = "byte_flip",
+                         world: int = 4, resume_world: Optional[int] = 2,
+                         total_steps: int = 6, save_every: int = 2,
+                         loss_tol: float = DEFAULT_LOSS_TOL) -> dict[str, Any]:
+    """The corruption drill (docs/elasticity.md "Integrity & walk-back"):
+    complete a run, deliberately corrupt its NEWEST checkpoint with ``kind``
+    (byte-flip / truncate / delete-item / stale-sidecar), then auto-resume —
+    on a different world size when ``resume_world`` differs, so the replan
+    path is exercised too — and prove, with no human intervention:
+
+    - the corrupt step is detected, quarantined (renamed + ledger entry),
+      and walked past;
+    - the restored step is the newest GOOD one, and the elastic replan keys
+      off the RESTORED step's manifest, not the corrupt latest;
+    - the resumed loss trajectory matches the control at pinned tolerance;
+    - the ``integrity`` trail lands in ``run_summary.json``.
+    """
+    import jax
+
+    from neuronx_distributed_training_tpu.checkpoint import (
+        inject_corruption,
+    )
+    from neuronx_distributed_training_tpu.checkpoint.integrity import (
+        parse_quarantine_name,
+        read_ledger,
+    )
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.trainer.elastic import (
+        discover_checkpoint_dir,
+    )
+
+    devices = jax.devices()
+    resume_world = int(resume_world if resume_world is not None else world)
+    if max(world, resume_world) > len(devices):
+        raise ValueError(
+            f"drill wants {max(world, resume_world)} devices, "
+            f"have {len(devices)}")
+    workdir = Path(workdir)
+
+    # 1. control: uninterrupted run at the original world size
+    control = run_segment(
+        tiny_llama_config(workdir / "control", max_steps=total_steps,
+                          save_every=save_every),
+        devices[:world])
+    assert control.get("metrics"), "control run produced no metrics"
+
+    # 2. the victim: a CLEAN completed run — the corruption hits the store
+    # after commit (bitrot / truncated upload), not the process
+    drill_cfg = tiny_llama_config(workdir / "drill", max_steps=total_steps,
+                                  save_every=save_every)
+    victim = run_segment(drill_cfg, devices[:world])
+    assert victim.get("metrics"), "victim run produced no metrics"
+    ck_dir = discover_checkpoint_dir(load_config(drill_cfg))
+    assert ck_dir is not None, "victim run left no checkpoint"
+    steps = sorted(int(p.name) for p in ck_dir.iterdir() if p.name.isdigit())
+    assert len(steps) >= 2, (
+        f"corruption drill needs >= 2 retained steps to walk back over, "
+        f"got {steps}")
+    corrupted_step, expect_step = steps[-1], steps[-2]
+    what = inject_corruption(ck_dir, corrupted_step, kind)
+    logger.info("corruption drill: %s", what)
+
+    # 3. auto-resume on the (possibly different) world — discovery must
+    # verify, quarantine the corrupt newest, and key the replan off the
+    # step actually restored
+    replan_world = resume_world if resume_world != world else None
+    resumed = run_segment(drill_cfg, devices[:resume_world],
+                          replan_world=replan_world)
+    assert resumed.get("metrics"), "resumed run produced no metrics"
+    record = resumed["record"]
+    if resume_world != world:
+        assert resumed["replanned"], (
+            f"world changed {world} -> {resume_world} but no replan happened")
+        assert int(record["checkpoint_step"]) == expect_step, (
+            f"replan keyed off step {record['checkpoint_step']}, not the "
+            f"verified step {expect_step} — the replanned layout would chase "
+            f"the corrupt latest")
+
+    # 4. quarantine really happened: renamed dir + ledger entry, and the
+    # corrupt step is invisible to discovery
+    qnames = [p.name for p in ck_dir.iterdir()
+              if parse_quarantine_name(p.name) == corrupted_step]
+    assert qnames, (
+        f"corrupt step {corrupted_step} was not quarantined "
+        f"(dir contents: {sorted(p.name for p in ck_dir.iterdir())})")
+    ledger_steps = [e.get("step") for e in read_ledger(ck_dir)]
+    assert corrupted_step in ledger_steps, (
+        f"quarantine ledger has no entry for step {corrupted_step}: "
+        f"{ledger_steps}")
+    # NOTE a fresh, healthy `<corrupted_step>` dir legitimately reappears:
+    # the resumed run retrains through that step and saves it again — the
+    # quarantined corpse and the new save coexist
+
+    # 5. the integrity trail is in run_summary.json and names the facts
+    summary_path = Path(resumed["run_dir"]) / "run_summary.json"
+    summary = (json.loads(summary_path.read_text())
+               if summary_path.exists() else {})
+    trail = dict(summary.get("integrity") or {})
+    assert int(trail.get("verified_step", -1)) == expect_step, trail
+    assert int(trail.get("walk_back_count", 0)) >= 1, trail
+    assert corrupted_step in (trail.get("quarantined_steps") or []), trail
+
+    # 6. loss-trajectory continuity: the steps retrained after the walk-back
+    # must match the control at pinned tolerance
+    control_losses = read_losses(control["run_dir"])
+    drill_losses = read_losses(resumed["run_dir"])
+    common = sorted(set(control_losses) & set(drill_losses))
+    assert common and max(common) == total_steps, (
+        f"resumed run never reached step {total_steps}: "
+        f"control={sorted(control_losses)}, drill={sorted(drill_losses)}")
+    worst = max(abs(control_losses[s] - drill_losses[s]) for s in common)
+    # same-world walk-back retrains from a bitwise-identical state over
+    # identical synthetic batches -> bitwise; cross-dp re-reduces -> pinned
+    tol = 0.0 if resume_world == world else loss_tol
+    assert worst <= tol, (
+        f"loss trajectory diverged after corruption walk-back: "
+        f"max |Δloss|={worst:.3e} > {tol:.0e} over steps {common}")
+
+    import time
+
+    return {
+        "ok": True,
+        "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "kind": kind,
+        "what": what,
+        "world": world, "resume_world": resume_world,
+        "corrupted_step": corrupted_step,
+        "resume_step": expect_step,
+        "walked_back": int(trail.get("walk_back_count", 0)),
+        "quarantined": trail.get("quarantined_steps"),
+        "replanned": bool(resumed["replanned"]),
+        "max_loss_diff": worst,
+        "loss_tol": loss_tol,
+        "run_dir": str(resumed["run_dir"]),
+    }
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
-                    help="CI gate: the canonical dp 4 -> 2 kill drill in a "
-                         "temp dir (single process, virtual CPU devices) — "
-                         "these ARE the defaults; the flag just documents "
-                         "intent in CI command lines")
+                    help="CI gate: the canonical dp 4 -> 2 kill drill PLUS "
+                         "a byte-flip corruption leg in a temp dir (single "
+                         "process, virtual CPU devices)")
+    ap.add_argument("--corrupt", default=None, metavar="KIND",
+                    help="run the corruption drill instead of the fault "
+                         "drill: corrupt the completed run's newest "
+                         "checkpoint with KIND (byte_flip/truncate/"
+                         "delete_item/stale_sidecar) and prove quarantine + "
+                         "walk-back + replan-off-the-verified-step")
     ap.add_argument("--at-step", type=int, default=3)
     ap.add_argument("--phase", choices=["step", "save", "restore"],
                     default="step")
@@ -389,18 +533,44 @@ def main(argv: Optional[list[str]] = None) -> int:
         import tempfile
 
         workdir = tempfile.mkdtemp(prefix="nxdt_elastic_drill_")
-    record_path = None if args.no_record else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "..", LAST_DRILL_PATH)
+    record_path = None if args.no_record else os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", LAST_DRILL_PATH))
     try:
-        report = run_drill(
-            workdir,
-            at_step=args.at_step, phase=args.phase, mode=args.mode,
-            world=args.world, resume_world=args.resume_world,
-            total_steps=args.steps, save_every=args.save_every,
-            loss_tol=args.loss_tol,
-            record_path=(os.path.normpath(record_path)
-                         if record_path else None),
-        )
+        if args.corrupt is not None:
+            report = run_corruption_drill(
+                workdir, kind=args.corrupt,
+                world=args.world, resume_world=args.resume_world,
+                total_steps=args.steps, save_every=args.save_every,
+                loss_tol=args.loss_tol,
+            )
+        else:
+            report = run_drill(
+                workdir,
+                at_step=args.at_step, phase=args.phase, mode=args.mode,
+                world=args.world, resume_world=args.resume_world,
+                total_steps=args.steps, save_every=args.save_every,
+                loss_tol=args.loss_tol,
+                record_path=record_path,
+            )
+            if args.smoke:
+                # the --smoke CI gate grows a corruption leg: newest step
+                # byte-flipped, auto-resume must quarantine + walk back +
+                # replan off the verified step (docs/elasticity.md)
+                corruption = run_corruption_drill(
+                    Path(workdir) / "corruption", kind="byte_flip",
+                    world=args.world, resume_world=args.resume_world,
+                    total_steps=args.steps, save_every=args.save_every,
+                    loss_tol=args.loss_tol,
+                )
+                report["integrity"] = {
+                    k: corruption.get(k)
+                    for k in ("kind", "corrupted_step", "resume_step",
+                              "walked_back", "max_loss_diff")
+                }
+                if record_path:
+                    with open(record_path, "w") as f:
+                        json.dump(report, f, indent=1)
+                        f.write("\n")
     except AssertionError as e:
         logger.error("drill FAILED: %s", e)
         if args.json:
@@ -408,14 +578,31 @@ def main(argv: Optional[list[str]] = None) -> int:
 
             write_json({"ok": False, "error": str(e)}, args.json)
         return 1
-    logger.info(
-        "drill OK: killed at step %d (%s/%s), resumed %d -> %d devices "
-        "from step %d; max |Δloss| %.2e, restart cost %.2fs, goodput %.4f",
-        report["at_step"], report["mode"], report["phase"], report["world"],
-        report["resume_world"], report["resume_step"],
-        report["max_loss_diff"], report["restart_cost_seconds"],
-        report["goodput_fraction"] or 0.0,
-    )
+    if args.corrupt is not None:
+        logger.info(
+            "corruption drill OK (%s): step %d corrupted -> quarantined, "
+            "resumed %d -> %d devices from step %d (walked back %d); "
+            "max |Δloss| %.2e",
+            report["kind"], report["corrupted_step"], report["world"],
+            report["resume_world"], report["resume_step"],
+            report["walked_back"], report["max_loss_diff"],
+        )
+    else:
+        logger.info(
+            "drill OK: killed at step %d (%s/%s), resumed %d -> %d devices "
+            "from step %d; max |Δloss| %.2e, restart cost %.2fs, goodput %.4f",
+            report["at_step"], report["mode"], report["phase"], report["world"],
+            report["resume_world"], report["resume_step"],
+            report["max_loss_diff"], report["restart_cost_seconds"],
+            report["goodput_fraction"] or 0.0,
+        )
+        if args.smoke and report.get("integrity"):
+            logger.info(
+                "corruption leg OK: %s at step %s -> walked back to %s",
+                report["integrity"]["kind"],
+                report["integrity"]["corrupted_step"],
+                report["integrity"]["resume_step"],
+            )
     if args.json:
         from _jsonout import write_json
 

@@ -149,6 +149,44 @@ def elastic_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def integrity_section(summary: dict) -> str:
+    """Checkpoint-integrity trail (checkpoint/integrity.py ->
+    run_summary.json "integrity"): which step actually verified at restore,
+    how many corrupt steps the walk-back skipped, what was quarantined, and
+    the post-commit audit's cost (docs/elasticity.md "Integrity &
+    walk-back")."""
+    it = summary.get("integrity")
+    if not isinstance(it, dict) or not it:
+        return ""
+    lines = ["", "integrity (verified restore — docs/elasticity.md)"]
+    if it.get("verified_step") is not None:
+        lines.append(f"  verified_step         {it['verified_step']}")
+    if it.get("walk_back_count") is not None:
+        lines.append(f"  walk_back_count       {it['walk_back_count']}")
+    q = it.get("quarantined_steps") or []
+    if q:
+        lines.append(f"  quarantined_steps     "
+                     f"{', '.join(str(s) for s in q)}")
+    if it.get("legacy_restore"):
+        lines.append("  legacy_restore        True (pre-integrity "
+                     "checkpoint, restored UNVERIFIED)")
+    if it.get("verify_seconds") is not None:
+        lines.append(f"  verify_seconds        {_fmt(it['verify_seconds'])}")
+    audit = it.get("audit")
+    if isinstance(audit, dict) and audit:
+        line = (f"  audit                 {audit.get('audited', 0)} step(s), "
+                f"{audit.get('failed', 0)} failed, "
+                f"{_fmt(audit.get('seconds', 0.0))} s")
+        if audit.get("incomplete"):
+            line += f", {audit['incomplete']} incomplete at teardown"
+        lines.append(line)
+        aq = it.get("audit_quarantined") or []
+        if aq:
+            lines.append(f"    audit_quarantined   "
+                         f"{', '.join(str(s) for s in aq)}")
+    return "\n".join(lines)
+
+
 def anomalies_section(summary: dict) -> str:
     """Flight-recorder trail: one line per forensic bundle the run dumped
     (render a bundle itself with ``tools/anomaly_report.py``)."""
@@ -246,6 +284,7 @@ def render(metrics_path: str | None, summary_path: str | None,
     if summary:
         parts.append(goodput_section(summary))
         parts.append(elastic_section(summary))
+        parts.append(integrity_section(summary))
         parts.append(anomalies_section(summary))
         parts.append(census_section(summary))
     if trace_path and os.path.exists(trace_path):
