@@ -32,6 +32,17 @@ def log(msg: str) -> None:
 
 
 def emit(payload: dict) -> None:
+    """The single JSON-line emitter.  A headline (metric-shaped) line REFUSES
+    to go out without a perf-contract verdict field: every BENCH_*.json line
+    must say whether the measurement was checked against the committed
+    baseline — ``{"verdict": "no_baseline"}`` is an acceptable answer,
+    silence is not (analysis.perf_contract, docs/observability.md)."""
+    if "metric" in payload and "perf_contract" not in payload:
+        raise RuntimeError(
+            "bench: refusing to emit a headline JSON line without a "
+            "perf_contract verdict field (populate it via "
+            "analysis.perf_contract.bench_verdict — 'no_baseline' counts)"
+        )
     print(json.dumps(payload), flush=True)
 
 
@@ -108,7 +119,7 @@ def json_float(v, ndigits: int = 4):
     return round(float(v), ndigits) if math.isfinite(v) else repr(float(v))
 
 
-def fail_json(err: str, **extra) -> None:
+def fail_json(err: str, provenance: dict | None = None, **extra) -> None:
     emit({
         "metric": "llama3_8B_pretrain_mfu",
         "value": 0.0,
@@ -116,6 +127,14 @@ def fail_json(err: str, **extra) -> None:
         "vs_baseline": 0.0,
         "error": err[-2000:],
         "last_measured": load_last_measured(),
+        # bench provenance (acquire mode, watchdog phase tag, handshake
+        # timing, backend identity): a dead round must be diagnosable from
+        # the artifact alone — rounds r02-r05 died before the backend and
+        # left nothing but an rc
+        "provenance": provenance or {},
+        # no measurement happened, so there is nothing to check — but the
+        # field must exist on every line (the emit contract)
+        "perf_contract": {"verdict": "no_measurement"},
         **extra,
     })
 
@@ -144,9 +163,26 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
     bench itself is the one and only client connection — a throwaway probe's
     teardown can wedge the tunnelled backend (bench_results/r4_notes.md).
     Legacy (``direct=False``): probe availability in a SUBPROCESS with a hard
-    timeout first.  Returns (device | None, diagnostic | None).
+    timeout first.  Returns (device | None, diagnostic | None, provenance).
+
+    ``provenance`` is the acquire's own forensic record — acquire mode, the
+    watchdog phase tag actually reached, PJRT handshake + first-RPC timing,
+    and the backend identity — persisted into EVERY bench JSON line so a
+    dead round (cf. r02-r05: probe timeout / PJRT handshake hang with no
+    artifact evidence) is diagnosable from the artifact alone.
     """
     import subprocess
+
+    def _prov(mode: str, **kw) -> dict:
+        out = {"acquire_mode": mode, "requested_platform": platform}
+        try:
+            import jax as _jax
+
+            out["jax_version"] = _jax.__version__
+        except Exception:  # noqa: BLE001 — provenance must never fail acquire
+            pass
+        out.update(kw)
+        return out
 
     if platform == "cpu":
         # cpu is in-process safe (no tunnel involved); tpu still goes through
@@ -154,7 +190,10 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         import jax
 
         jax.config.update("jax_platforms", platform)
-        return jax.devices()[0], None
+        d = jax.devices()[0]
+        return d, None, _prov("in-process-cpu", connect_phase="connected",
+                              platform=d.platform,
+                              device_kind=d.device_kind)
 
     if direct:
         # Round-4 connection discipline: do NOT burn a throwaway probe
@@ -212,6 +251,17 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
             "error": f"backend connect hung > {connect_timeout_s:.0f}s "
                      f"(direct in-process acquire)",
             "last_measured": load_last_measured(),
+            # the killer prints this while the parent is FROZEN, so it
+            # cannot know which phase wedged — the stderr loop log carries
+            # the last "bench: connect phase:" line; this records that the
+            # watchdog fired and with what budget
+            "provenance": _prov(
+                "direct",
+                connect_phase="hung (watchdog kill; the stderr log's last "
+                              "'bench: connect phase:' line names the "
+                              "wedged phase)",
+                connect_timeout_seconds=connect_timeout_s),
+            "perf_contract": {"verdict": "no_measurement"},
         })
         # The killer verifies the target is still THIS process before SIGKILL
         # (ADVICE r4: the parent may have exited at T via the watchdog and its
@@ -269,22 +319,32 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
             # connect (round-1 "transiently UNAVAILABLE, rc=1" mode) must
             # return a diagnostic, not crash past the only JSON emitter
             return None, (f"direct connect raised in phase '{phase['name']}': "
-                          f"{type(e).__name__}: {e}")
+                          f"{type(e).__name__}: {e}"), _prov(
+                "direct", connect_phase=phase["name"],
+                connect_timeout_seconds=connect_timeout_s,
+                error=f"{type(e).__name__}: {e}"[:300])
         # ADVICE r4: if the plugin fails fast JAX can silently fall back to
         # CPU and we'd emit a success-shaped CPU line.  JAX_PLATFORMS=axon in
         # the env should prevent that, but pin it explicitly.
         want_tpu = platform == "tpu" or (
             platform is None
             and os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"))
+        prov = _prov("direct", connect_phase="connected",
+                     plugin_init_seconds=round(t_init, 3),
+                     first_rpc_seconds=round(t_rpc, 3),
+                     platform=d.platform, device_kind=d.device_kind,
+                     connect_timeout_seconds=connect_timeout_s)
         if want_tpu and d.platform == "cpu":
-            return None, "wanted tpu, got platform=cpu (silent CPU fallback)"
+            return None, "wanted tpu, got platform=cpu (silent CPU fallback)", \
+                dict(prov, connect_phase="silent-cpu-fallback")
         log(f"bench: direct backend acquire ok ({d.platform} {d.device_kind}) "
             f"plugin-init={t_init:.2f}s first-rpc={t_rpc:.2f}s")
-        return d, None
+        return d, None, prov
 
     last = ""
     for attempt in range(retries):
         try:
+            t_probe = time.perf_counter()
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
                 capture_output=True, text=True, timeout=probe_timeout_s,
@@ -293,7 +353,12 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
                 log(f"bench: backend probe ok ({r.stdout.strip().split()[-1]})")
                 import jax
 
-                return jax.devices()[0], (last or None)
+                d = jax.devices()[0]
+                return d, (last or None), _prov(
+                    "probe-subprocess", connect_phase="connected",
+                    probe_seconds=round(time.perf_counter() - t_probe, 3),
+                    probe_attempts=attempt + 1,
+                    platform=d.platform, device_kind=d.device_kind)
             last = (r.stderr or r.stdout).strip()[-500:]
         except subprocess.TimeoutExpired:
             last = f"backend probe timed out after {probe_timeout_s:.0f}s"
@@ -302,7 +367,9 @@ def acquire_device(retries: int = 2, probe_timeout_s: float = 100.0,
         log(f"bench: backend attempt {attempt + 1}/{retries} failed: {last}")
         if attempt + 1 < retries:
             time.sleep(delay_s)
-    return None, last
+    return None, last, _prov("probe-subprocess",
+                             connect_phase="probe-failed",
+                             probe_attempts=retries, error=last[:300])
 
 
 def layer_budget(hbm_bytes: int, bytes_per_param: float, *,
@@ -657,6 +724,24 @@ def plan_topk_measure(dev, base_cfg, policy, precision_block, seq: int,
             row["measured_ms"] = r["ms_per_step"]
             predicted.append(cand.estimate.step_seconds * 1e3)
             measured.append(r["ms_per_step"])
+            # per-term predicted-vs-measured residuals: the cost model
+            # audited against this benched plan (analysis.perf_contract;
+            # comms/bubble terms stay None unless a trace/timeline measured
+            # them — the audit never pretends)
+            try:
+                from neuronx_distributed_training_tpu.analysis.perf_contract import (  # noqa: E501
+                    residual_report,
+                )
+
+                row["residuals"] = residual_report(
+                    cand.estimate.to_dict(),
+                    {"step_seconds": r["ms_per_step"] / 1e3,
+                     "exposed_collective_seconds": r.get(
+                         "exposed_collective_seconds"),
+                     "bubble_fraction_measured": r.get(
+                         "bubble_fraction_measured")})
+            except Exception as e:  # noqa: BLE001 — residuals are advisory
+                log(f"bench: residual report unavailable: {e}")
         except Exception as e:  # noqa: BLE001 — one failed plan must not
             # kill the sweep (and its failure is itself signal)
             row["error"] = f"{type(e).__name__}: {e}"[:300]
@@ -720,17 +805,22 @@ def main() -> None:
                          "exposed_collective_seconds in the JSON line — "
                          "the signal the autotune cost model's comms term "
                          "calibrates against")
+    ap.add_argument("--contract-key", default=None, metavar="NAME",
+                    help="perf-contract baseline key override (default: "
+                         "derived from the device identity, e.g. cpu_bench "
+                         "— analysis/perf_baselines/<key>.json)")
     ap.add_argument("--calibration", action="store_true",
                     help="low-fidelity connect-reliability run: append to the "
                          "measured log but do NOT refresh last_measured.json "
                          "(the authoritative headline line)")
     args = ap.parse_args()
 
-    dev, backend_err = acquire_device(platform=args.platform,
-                                      direct=args.direct,
-                                      connect_timeout_s=args.connect_timeout)
+    dev, backend_err, provenance = acquire_device(
+        platform=args.platform, direct=args.direct,
+        connect_timeout_s=args.connect_timeout)
     if dev is None:
-        fail_json(f"no backend available: {backend_err}")
+        fail_json(f"no backend available: {backend_err}",
+                  provenance=provenance)
         return
 
     from neuronx_distributed_training_tpu.models import llama
@@ -830,6 +920,7 @@ def main() -> None:
 
     if not results:
         fail_json("; ".join(f"{k}: {v}" for k, v in errors.items()) or "no regime ran",
+                  provenance=provenance,
                   device=getattr(dev, "device_kind", str(dev)))
         return
 
@@ -880,6 +971,10 @@ def main() -> None:
         # summaries share a schema (plan-topk rows carry per-plan values)
         "pipeline_schedule": "none",
         "bubble_fraction_predicted": 0.0,
+        # bench provenance: acquire mode, watchdog phase tag reached, PJRT
+        # handshake + first-RPC timing, backend identity — on EVERY line, so
+        # a dead round is diagnosable from the artifact alone (r02-r05)
+        "provenance": provenance,
         "note": ("deepest Llama-3-8B-shape stack fitting single-chip HBM "
                  "(tied embeddings, pinned config); MFU is per-layer-shape-bound"),
     }
@@ -927,6 +1022,26 @@ def main() -> None:
         payload["calibration"] = True
         payload["steps"] = steps
         payload["warmup"] = warmup
+    # the perf-contract verdict: the measured line checked against the
+    # committed per-topology baseline (analysis.perf_contract) — emit()
+    # REFUSES a headline line without this field, and "no_baseline" is an
+    # honest verdict where silence would not be
+    try:
+        from neuronx_distributed_training_tpu.analysis import (
+            perf_contract as _pc,
+        )
+
+        facts = _pc.perf_facts_from_bench(payload)
+        key = args.contract_key or _pc.default_key(facts)
+        payload["perf_contract"] = _pc.bench_verdict(key, facts)
+        log(f"bench: perf contract [{key}]: "
+            f"{payload['perf_contract']['verdict']}")
+    except Exception as e:  # noqa: BLE001 — the verdict must not kill the
+        # line, but its absence must be explained
+        payload["perf_contract"] = {
+            "verdict": "unavailable",
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }
     if on_tpu:
         record_measurement(payload, refresh_last=not args.calibration)
     emit(payload)

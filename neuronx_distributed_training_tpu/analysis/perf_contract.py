@@ -1,0 +1,808 @@
+"""Perf contracts: a measured-runtime regression ratchet with noise bands.
+
+Graph contracts (``analysis.graph_contract``) gate what the compiled step
+*contains*; trace analytics (``telemetry.trace_analysis``) measure where
+device time *went*.  This module closes the loop the ROADMAP demands: the
+measured numbers themselves become a committed contract, so a step-time,
+overlap, or bubble regression fails CI with a *named* finding instead of
+silently eroding the recorded baselines.
+
+- **facts** — the canonical measured-runtime record of one workload:
+  step time, MFU/throughput, achieved overlap per collective class,
+  exposed collective seconds, and the measured pipeline bubble fraction
+  (``telemetry.step_timeline``).  Extracted uniformly from a ``bench.py``
+  JSON line, a run dir (``run_summary.json`` + ``metrics.jsonl`` +
+  ``trace_summary.json``), or a bare ``trace_summary.json``.
+- **baselines** — committed per-topology snapshots under
+  ``analysis/perf_baselines/<key>.json`` carrying the facts plus explicit
+  *noise bands* (runtime is noisy where compile artifacts are exact; every
+  band is visible in-file, not folded into the code).
+- **the differ** — ``diff_facts`` explains a regression in subsystem terms
+  (PC101 step time, PC102 throughput/MFU, PC201 per-class achieved
+  overlap, PC202 exposed collective seconds naming the collective class,
+  PC301 measured bubble growth, PC302 measured-vs-predicted bubble outside
+  the calibration band, PC401 cost-model residual drift); improvements are
+  PC110 info findings the snapshot can tighten to.
+- **the ratchet** — same workflow as graph contracts:
+  ``tools/perf_contract.py --check`` fails on any error finding;
+  ``--update-baselines`` commits improvements silently and refuses to
+  commit a regression without ``--justify`` (recorded in-file).
+- **residuals** — ``residual_report`` audits the autotune cost model term
+  by term (compute/comms/bubble) against a measured plan, the record
+  ``bench.py --plan-topk`` persists per benched plan and
+  ``tools/plan.py --calibrate-from`` surfaces next to the priors it
+  replaces.
+
+``docs/observability.md`` ("Perf contracts") documents the workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+from neuronx_distributed_training_tpu.analysis.report import AuditReport
+
+#: committed measured-runtime baselines, one per (topology, workload) key
+BASELINES_DIR = Path(__file__).resolve().parent / "perf_baselines"
+
+#: facts schema version — the differ refuses to compare across versions
+FACTS_VERSION = 1
+
+#: default noise bands.  Runtime numbers jitter run-to-run (scheduler,
+#: clocks, host load) where compile artifacts don't; each band says how much
+#: drift is noise and is recorded IN the baseline file so a topology can
+#: carry its own (CPU smoke baselines need far wider time bands than a
+#: pinned TPU chip).
+DEFAULT_NOISE: dict[str, float] = {
+    "step_time_frac": 0.25,       # step-time growth beyond this fails
+    "throughput_frac": 0.25,      # tokens/sec shrink beyond this fails
+    "mfu_abs": 0.03,              # MFU points (fraction) lost beyond this
+    "overlap_abs": 0.10,          # per-class achieved-overlap drop
+    "exposed_frac": 0.50,         # per-class exposed-seconds growth...
+    "exposed_min_seconds": 0.002,  # ...with an absolute floor under it
+    "bubble_abs": 0.08,           # measured bubble-fraction growth; ALSO the
+                                  # measured-vs-predicted calibration band
+    "residual_frac": 0.30,        # cost-model total-residual drift
+}
+
+#: which subsystem a measured collective class's regression points at —
+#: measured traces know kinds, not mesh axes, so the finding names the
+#: likely axes and the code that owns them (the same kind->axis table the
+#: cost model and graph contracts share: utils.debug.AXIS_COLLECTIVE_KINDS)
+CLASS_HINTS: dict[str, tuple[str, str]] = {
+    "reduce-scatter": ("dp", "ZeRO-1 gradient reduce-scatter stopped hiding "
+                             "under compute; check optim/zero1 and the "
+                             "update-boundary issue order"),
+    "all-gather": ("dp/tp", "ZeRO-1 parameter all-gather / SP layer-gather "
+                            "overlap regressed; check optim/zero1 and the "
+                            "layer PartitionSpecs"),
+    "all-reduce": ("dp/tp", "gradient/loss or plain-TP layer reduction "
+                            "overlap regressed; check trainer/step.py and "
+                            "the layer collectives"),
+    "collective-permute": ("pp/cp", "pipeline stage-hop / ring-attention "
+                                    "kv-pass overlap regressed; check "
+                                    "parallel/pipeline.py scheduling"),
+    "all-to-all": ("ep/cp", "expert dispatch / ulysses head-exchange "
+                            "overlap regressed; check ops/moe.py and "
+                            "parallel/ulysses.py"),
+}
+
+_RATCHET_HINT = (
+    "declare a deliberate change: tools/perf_contract.py --update-baselines "
+    "--justify '<why the measured number moved>' (the ratchet only "
+    "improves silently)"
+)
+
+
+class PerfContractError(RuntimeError):
+    """A facts source could not be read, or the ratchet refused an update."""
+
+
+def _num(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or v is None:
+        return None
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+# --------------------------------------------------------------------------
+# facts extraction
+# --------------------------------------------------------------------------
+
+
+def _class_record(entry: Any) -> Optional[dict[str, Any]]:
+    """Normalize one overlap_by_class value: trace summaries carry full
+    {wire,hidden,exposed,achieved_overlap} records, bench lines carry bare
+    fractions."""
+    if isinstance(entry, Mapping):
+        out = {}
+        for src, dst in (("achieved_overlap", "achieved_overlap"),
+                         ("exposed_seconds", "exposed_seconds"),
+                         ("wire_seconds", "wire_seconds")):
+            v = _num(entry.get(src))
+            if v is not None:
+                out[dst] = v
+        return out or None
+    v = _num(entry)
+    return {"achieved_overlap": v} if v is not None else None
+
+
+def _overlap_classes(mapping: Any) -> dict[str, dict[str, Any]]:
+    out: dict[str, dict[str, Any]] = {}
+    for kind, entry in dict(mapping or {}).items():
+        rec = _class_record(entry)
+        if rec:
+            out[str(kind)] = rec
+    return out
+
+
+def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
+    """Canonical facts out of one ``bench.py`` headline JSON line."""
+    mfu = _num(payload.get("mfu"))
+    if mfu is None and _num(payload.get("value")) is not None \
+            and payload.get("unit") == "percent_mfu":
+        mfu = _num(payload.get("value")) / 100.0
+    pipe = payload.get("pipeline") if isinstance(
+        payload.get("pipeline"), Mapping) else {}
+    return {
+        "version": FACTS_VERSION,
+        "workload": {
+            "source": "bench",
+            "metric": payload.get("metric"),
+            "device": payload.get("device"),
+            "regime": payload.get("regime"),
+            "seq_len": payload.get("seq_len"),
+            "num_layers": payload.get("num_layers"),
+            "schedule": payload.get("pipeline_schedule"),
+        },
+        "step_time_ms": _num(payload.get("ms_per_step")),
+        "mfu": mfu,
+        "tokens_per_sec": _num(payload.get("tokens_per_sec_per_chip")),
+        "achieved_overlap": _num(payload.get("achieved_overlap")),
+        "exposed_collective_seconds": _num(
+            payload.get("exposed_collective_seconds")),
+        "overlap_by_class": _overlap_classes(payload.get("overlap_by_class")),
+        "bubble_fraction_measured": _num(
+            payload.get("bubble_fraction_measured")
+            if payload.get("bubble_fraction_measured") is not None
+            else pipe.get("bubble_fraction_measured")),
+        "bubble_fraction_predicted": _num(
+            payload.get("bubble_fraction_predicted")),
+        "residuals": payload.get("residuals")
+        if isinstance(payload.get("residuals"), Mapping) else None,
+    }
+
+
+def perf_facts_from_trace_summary(summary: Mapping[str, Any]
+                                  ) -> dict[str, Any]:
+    """Facts out of a bare ``trace_summary.json`` payload (no step time /
+    MFU — those need the run's metrics or a bench line)."""
+    pipe = summary.get("pipeline") if isinstance(
+        summary.get("pipeline"), Mapping) else {}
+    return {
+        "version": FACTS_VERSION,
+        "workload": {
+            "source": "trace",
+            "schedule": pipe.get("schedule"),
+        },
+        "step_time_ms": None,
+        "mfu": None,
+        "tokens_per_sec": None,
+        "achieved_overlap": _num(summary.get("achieved_overlap")),
+        "exposed_collective_seconds": _num(
+            summary.get("exposed_collective_seconds")),
+        "overlap_by_class": _overlap_classes(summary.get("overlap_by_class")),
+        "bubble_fraction_measured": _num(pipe.get("bubble_fraction_measured")),
+        "bubble_fraction_predicted": _num(
+            pipe.get("bubble_fraction_predicted")),
+        "residuals": None,
+    }
+
+
+def perf_facts_from_run(run_dir: str | Path) -> dict[str, Any]:
+    """Facts out of a training run dir: ``run_summary.json`` run facts +
+    ``trace_summary.json`` measurements + the last ``metrics.jsonl``
+    boundary record (throughput/MFU)."""
+    run_dir = Path(run_dir)
+    try:
+        run_summary = json.loads((run_dir / "run_summary.json").read_text())
+    except (OSError, ValueError) as e:
+        raise PerfContractError(
+            f"no readable run_summary.json under {run_dir}: {e}") from e
+    trace = {}
+    try:
+        trace = json.loads((run_dir / "trace_summary.json").read_text())
+    except (OSError, ValueError):
+        pass
+    last_metrics: dict[str, Any] = {}
+    try:
+        for line in (run_dir / "metrics.jsonl").read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a live run
+            if isinstance(rec, dict):
+                last_metrics.update(
+                    {k: v for k, v in rec.items()
+                     if isinstance(v, (int, float))})
+    except OSError:
+        pass
+    facts = perf_facts_from_trace_summary(trace)
+    tokens = _num(last_metrics.get("tokens_per_sec_per_chip"))
+    seq = _num(run_summary.get("seq_len"))
+    gbs = _num(run_summary.get("global_batch_size"))
+    chips = _num(run_summary.get("n_chips"))
+    step_ms = None
+    if tokens and seq and gbs and chips:
+        # one source of truth: step time derives from the same throughput
+        # window MFU does (tokens/sec/chip x chips = tokens/sec)
+        step_ms = gbs * seq / (tokens * chips) * 1e3
+    facts.update({
+        "workload": {
+            "source": "run",
+            "model_family": run_summary.get("model_family"),
+            "n_chips": run_summary.get("n_chips"),
+            "seq_len": run_summary.get("seq_len"),
+            "schedule": run_summary.get("pipeline_schedule"),
+        },
+        "step_time_ms": step_ms,
+        "mfu": _num(last_metrics.get("mfu")),
+        "tokens_per_sec": tokens,
+        "bubble_fraction_predicted": _num(
+            run_summary.get("bubble_fraction_predicted"))
+        if _num(run_summary.get("bubble_fraction_predicted")) is not None
+        else facts.get("bubble_fraction_predicted"),
+    })
+    if facts.get("bubble_fraction_measured") is None:
+        facts["bubble_fraction_measured"] = _num(
+            run_summary.get("bubble_fraction_measured"))
+    return facts
+
+
+def load_facts(source: Any) -> dict[str, Any]:
+    """Facts from any accepted source: an already-canonical facts mapping, a
+    bench JSON line (mapping or file), a run dir, a ``trace_summary.json``,
+    or a ``.jsonl`` whose LAST parseable line is a bench record."""
+    if isinstance(source, Mapping):
+        doc = dict(source)
+    else:
+        p = Path(source)
+        if p.is_dir():
+            if (p / "run_summary.json").exists():
+                return perf_facts_from_run(p)
+            if (p / "trace_summary.json").exists():
+                doc = json.loads((p / "trace_summary.json").read_text())
+            else:
+                raise PerfContractError(
+                    f"{p}: no run_summary.json or trace_summary.json — "
+                    f"nothing to extract perf facts from")
+        else:
+            try:
+                text = p.read_text()
+            except OSError as e:
+                raise PerfContractError(f"unreadable facts source {p}: {e}") \
+                    from e
+            doc = None
+            if p.suffix == ".jsonl":
+                for line in reversed(text.splitlines()):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+            else:
+                try:
+                    doc = json.loads(text)
+                except ValueError:
+                    # a bench stdout capture: the JSON line is the last
+                    # parseable line (the tools/_jsonout contract)
+                    for line in reversed(text.splitlines()):
+                        try:
+                            doc = json.loads(line.strip())
+                            break
+                        except ValueError:
+                            continue
+            if not isinstance(doc, dict):
+                raise PerfContractError(
+                    f"{p}: no parseable JSON object found")
+    if doc.get("version") == FACTS_VERSION and "workload" in doc:
+        return doc
+    if "metric" in doc and "value" in doc:
+        return perf_facts_from_bench(doc)
+    if "overlap_by_class" in doc or "top_ops" in doc:
+        return perf_facts_from_trace_summary(doc)
+    raise PerfContractError(
+        "unrecognized facts source: expected a bench JSON line, a "
+        "trace_summary.json, a run dir, or a canonical facts record")
+
+
+def default_key(facts: Mapping[str, Any]) -> str:
+    """Baseline key for a facts record: the device identity slug (the
+    baseline is per-topology) plus the source kind."""
+    w = dict(facts.get("workload") or {})
+    dev = str(w.get("device") or w.get("model_family") or "unknown")
+    slug = "".join(c if c.isalnum() else "_" for c in dev.lower()).strip("_")
+    while "__" in slug:
+        slug = slug.replace("__", "_")
+    src = str(w.get("source") or "bench")
+    return f"{slug}_{src}" if src != "bench" else f"{slug}_bench"
+
+
+# --------------------------------------------------------------------------
+# the semantic differ (PC findings)
+# --------------------------------------------------------------------------
+
+
+def _fmt(v: Optional[float], nd: int = 4) -> str:
+    return "n/a" if v is None else f"{round(float(v), nd):g}"
+
+
+def calibration_findings(facts: Mapping[str, Any],
+                         noise: Mapping[str, float],
+                         report: AuditReport) -> None:
+    """PC302 — baseline-independent: the measured bubble fraction must stay
+    within the calibration band of the planner's prediction.  This is
+    ROADMAP item 1's success metric as a gate: a lockstep executor burning
+    the priced bubble (or a broken bubble price) fails here even on a
+    freshly baselined topology."""
+    measured = _num(facts.get("bubble_fraction_measured"))
+    predicted = _num(facts.get("bubble_fraction_predicted"))
+    if measured is None or predicted is None:
+        return
+    band = float(noise.get("bubble_abs", DEFAULT_NOISE["bubble_abs"]))
+    if measured > predicted + band:
+        sched = (facts.get("workload") or {}).get("schedule")
+        report.add(
+            "PC302", "error",
+            f"measured pipeline bubble fraction {_fmt(measured)} exceeds "
+            f"the planner's prediction {_fmt(predicted)} by more than the "
+            f"{_fmt(band)} calibration band"
+            + (f" (schedule {sched})" if sched else ""),
+            hint="the executor is idling beyond the priced fill/drain "
+                 "bubble (straggler stage, masked-tick burn, or a broken "
+                 "bubble price) — see trace_summary.json 'pipeline' "
+                 "straggler attribution, and parallel/pipeline.py "
+                 "bubble_multiplier if the price itself is wrong",
+        )
+
+
+def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
+               noise: Optional[Mapping[str, float]] = None,
+               config_name: str = "") -> AuditReport:
+    """Compare fresh measured facts against a committed baseline.
+
+    Error findings are regressions beyond the noise band (the ratchet's
+    fail condition); info findings (PC110) are improvements the baseline
+    can tighten to.  Every message names the measured quantity, both
+    values, and the band it broke."""
+    report = AuditReport(config=config_name)
+    bands = dict(DEFAULT_NOISE, **(noise or {}))
+
+    if old.get("version") != new.get("version"):
+        report.add(
+            "PC001", "error",
+            f"facts version changed {old.get('version')} -> "
+            f"{new.get('version')}: the committed baseline predates the "
+            f"current schema",
+            hint="regenerate: tools/perf_contract.py --update-baselines",
+        )
+        return report
+    ow, nw = dict(old.get("workload") or {}), dict(new.get("workload") or {})
+    mismatched = {
+        k: (ow.get(k), nw.get(k))
+        for k in ("device", "seq_len", "num_layers", "schedule", "regime",
+                  "n_chips", "model_family")
+        if ow.get(k) is not None and nw.get(k) is not None
+        and ow.get(k) != nw.get(k)
+    }
+    if mismatched:
+        detail = ", ".join(f"{k}: {a!r} -> {b!r}"
+                           for k, (a, b) in sorted(mismatched.items()))
+        report.add(
+            "PC001", "error",
+            f"workload identity changed ({detail}): these measurements are "
+            f"not comparable to the committed baseline",
+            hint="a deliberate workload change must re-baseline: "
+                 "tools/perf_contract.py --update-baselines --justify "
+                 "'<why>'",
+        )
+        return report
+
+    # -- PC101: step time --------------------------------------------------
+    a, b = _num(old.get("step_time_ms")), _num(new.get("step_time_ms"))
+    if a and b:
+        band = bands["step_time_frac"]
+        if b > a * (1.0 + band):
+            report.add(
+                "PC101", "error",
+                f"step time grew {_fmt(a, 2)}ms -> {_fmt(b, 2)}ms "
+                f"(+{100 * (b / a - 1):.0f}% > {100 * band:.0f}% noise band)",
+                hint=_RATCHET_HINT,
+            )
+        elif b < a * (1.0 - band):
+            report.add(
+                "PC110", "info",
+                f"step time improved {_fmt(a, 2)}ms -> {_fmt(b, 2)}ms — "
+                f"tighten the baseline with --update-baselines",
+            )
+
+    # -- PC102: MFU / throughput -------------------------------------------
+    a, b = _num(old.get("mfu")), _num(new.get("mfu"))
+    if a is not None and b is not None:
+        band = bands["mfu_abs"]
+        if b < a - band:
+            report.add(
+                "PC102", "error",
+                f"MFU fell {_fmt(a)} -> {_fmt(b)} "
+                f"(-{a - b:.4f} > {band:g} noise band)",
+                hint=_RATCHET_HINT,
+            )
+        elif b > a + band:
+            report.add(
+                "PC110", "info",
+                f"MFU improved {_fmt(a)} -> {_fmt(b)} — tighten the "
+                f"baseline with --update-baselines",
+            )
+    else:
+        a, b = _num(old.get("tokens_per_sec")), _num(new.get("tokens_per_sec"))
+        if a and b:
+            band = bands["throughput_frac"]
+            if b < a * (1.0 - band):
+                report.add(
+                    "PC102", "error",
+                    f"throughput fell {_fmt(a, 1)} -> {_fmt(b, 1)} "
+                    f"tokens/sec (-{100 * (1 - b / a):.0f}% > "
+                    f"{100 * band:.0f}% noise band)",
+                    hint=_RATCHET_HINT,
+                )
+            elif b > a * (1.0 + band):
+                report.add(
+                    "PC110", "info",
+                    f"throughput improved {_fmt(a, 1)} -> {_fmt(b, 1)} "
+                    f"tokens/sec — tighten with --update-baselines",
+                )
+
+    # -- PC201/PC202: per-collective-class overlap and exposed seconds -----
+    oc = _overlap_classes(old.get("overlap_by_class"))
+    ncl = _overlap_classes(new.get("overlap_by_class"))
+    for kind in sorted(set(oc) & set(ncl)):
+        axes, subsystem = CLASS_HINTS.get(
+            kind, ("?", "collective overlap regressed"))
+        a = _num(oc[kind].get("achieved_overlap"))
+        b = _num(ncl[kind].get("achieved_overlap"))
+        if a is not None and b is not None:
+            band = bands["overlap_abs"]
+            if b < a - band:
+                report.add(
+                    "PC201", "error",
+                    f"[{axes}]-axis {kind} achieved overlap fell "
+                    f"{_fmt(a)} -> {_fmt(b)} (beyond the {band:g} band): "
+                    f"{subsystem}",
+                    location=kind,
+                    hint=_RATCHET_HINT,
+                )
+            elif b > a + band:
+                report.add(
+                    "PC110", "info",
+                    f"[{axes}]-axis {kind} achieved overlap improved "
+                    f"{_fmt(a)} -> {_fmt(b)} — tighten with "
+                    f"--update-baselines",
+                )
+        a = _num(oc[kind].get("exposed_seconds"))
+        b = _num(ncl[kind].get("exposed_seconds"))
+        if a is not None and b is not None:
+            band = bands["exposed_frac"]
+            floor = bands["exposed_min_seconds"]
+            if b > a * (1.0 + band) and b - a > floor:
+                report.add(
+                    "PC202", "error",
+                    f"[{axes}]-axis exposed {kind} seconds grew "
+                    f"{_fmt(a)}s -> {_fmt(b)}s "
+                    f"(+{100 * (b / a - 1):.0f}% > {100 * band:.0f}% band): "
+                    f"{subsystem}" if a > 0 else
+                    f"[{axes}]-axis exposed {kind} seconds appeared: "
+                    f"{_fmt(a)}s -> {_fmt(b)}s: {subsystem}",
+                    location=kind,
+                    hint=_RATCHET_HINT,
+                )
+            elif b < a * (1.0 - band) and a - b > floor:
+                report.add(
+                    "PC110", "info",
+                    f"[{axes}]-axis exposed {kind} seconds shrank "
+                    f"{_fmt(a)}s -> {_fmt(b)}s — tighten with "
+                    f"--update-baselines",
+                )
+
+    # overall exposed wire time (catches a class that vanished from the
+    # per-class table by being renamed)
+    a = _num(old.get("exposed_collective_seconds"))
+    b = _num(new.get("exposed_collective_seconds"))
+    if a is not None and b is not None:
+        band, floor = bands["exposed_frac"], bands["exposed_min_seconds"]
+        if b > a * (1.0 + band) and b - a > floor:
+            report.add(
+                "PC202", "error",
+                f"total exposed collective seconds grew {_fmt(a)}s -> "
+                f"{_fmt(b)}s (+{100 * (b / a - 1):.0f}% > "
+                f"{100 * band:.0f}% band)" if a > 0 else
+                f"total exposed collective seconds appeared: {_fmt(a)}s -> "
+                f"{_fmt(b)}s",
+                location="overall",
+                hint=_RATCHET_HINT,
+            )
+
+    # -- PC301: measured bubble fraction -----------------------------------
+    a = _num(old.get("bubble_fraction_measured"))
+    b = _num(new.get("bubble_fraction_measured"))
+    if a is not None and b is not None:
+        band = bands["bubble_abs"]
+        if b > a + band:
+            report.add(
+                "PC301", "error",
+                f"measured pipeline bubble fraction grew {_fmt(a)} -> "
+                f"{_fmt(b)} (beyond the {band:g} band): the pipeline is "
+                f"idling more than the committed baseline",
+                hint="trace_summary.json 'pipeline' names the straggler "
+                     "stage and the per-tick busy/idle split; "
+                     + _RATCHET_HINT,
+            )
+        elif b < a - band:
+            report.add(
+                "PC110", "info",
+                f"measured bubble fraction improved {_fmt(a)} -> {_fmt(b)} "
+                f"— tighten with --update-baselines",
+            )
+
+    # -- PC302: measured vs predicted (baseline-independent) ---------------
+    calibration_findings(new, bands, report)
+
+    # -- PC401: cost-model residual drift ----------------------------------
+    orr = (old.get("residuals") or {}).get("total") or {}
+    nrr = (new.get("residuals") or {}).get("total") or {}
+    a, b = _num(orr.get("ratio")), _num(nrr.get("ratio"))
+    if a and b:
+        band = bands["residual_frac"]
+        if b / a > 1.0 + band or b / a < 1.0 / (1.0 + band):
+            report.add(
+                "PC401", "error",
+                f"cost-model total residual (measured/predicted step time) "
+                f"drifted {_fmt(a, 3)} -> {_fmt(b, 3)}: the planner's "
+                f"pricing decalibrated beyond the {band:g} band",
+                hint="re-audit the cost model terms against the per-plan "
+                     "residual records (bench.py --plan-topk) and "
+                     "recalibrate priors with tools/plan.py "
+                     "--calibrate-from; " + _RATCHET_HINT,
+            )
+
+    report.stats["step_time_ms"] = _num(new.get("step_time_ms"))
+    report.stats["bubble_fraction_measured"] = _num(
+        new.get("bubble_fraction_measured"))
+    return report
+
+
+# --------------------------------------------------------------------------
+# residuals: the cost model audited term by term
+# --------------------------------------------------------------------------
+
+
+def residual_report(estimate: Mapping[str, Any],
+                    measured: Mapping[str, Any]) -> dict[str, Any]:
+    """Predicted-vs-measured residuals per cost-model term for one benched
+    plan.
+
+    ``estimate`` is a :class:`~autotune.cost_model.PlanEstimate` dict
+    (``to_dict()``); ``measured`` carries whatever was actually observed:
+    ``step_seconds`` (required), optionally ``exposed_collective_seconds``
+    (trace-measured — the comms term's ground truth) and
+    ``bubble_fraction_measured`` (timeline-measured).  Terms without a
+    measurement report ``measured: None`` rather than pretending — the
+    planner's priors are audited only where evidence exists."""
+    pred_total = _num(estimate.get("step_seconds"))
+    m_total = _num(measured.get("step_seconds"))
+    out: dict[str, Any] = {
+        "total": {
+            "predicted_seconds": pred_total,
+            "measured_seconds": m_total,
+            "ratio": round(m_total / pred_total, 4)
+            if pred_total and m_total else None,
+        }
+    }
+    pred_comms = _num(estimate.get("comms_seconds"))
+    m_exposed = _num(measured.get("exposed_collective_seconds"))
+    out["comms"] = {
+        "predicted_seconds": pred_comms,
+        "measured_exposed_seconds": m_exposed,
+        "ratio": round(m_exposed / pred_comms, 4)
+        if pred_comms and m_exposed is not None else None,
+    }
+    pred_bubble_s = _num(estimate.get("bubble_seconds"))
+    pred_bubble_frac = (round(pred_bubble_s / pred_total, 6)
+                        if pred_total and pred_bubble_s is not None else None)
+    m_bubble_frac = _num(measured.get("bubble_fraction_measured"))
+    out["bubble"] = {
+        "predicted_fraction": pred_bubble_frac,
+        "measured_fraction": m_bubble_frac,
+        "residual": round(m_bubble_frac - pred_bubble_frac, 6)
+        if m_bubble_frac is not None and pred_bubble_frac is not None
+        else None,
+    }
+    pred_compute = _num(estimate.get("compute_seconds"))
+    m_compute = None
+    if m_total is not None and m_exposed is not None \
+            and m_bubble_frac is not None:
+        m_compute = max(m_total - m_exposed - m_bubble_frac * m_total, 0.0)
+    out["compute"] = {
+        "predicted_seconds": pred_compute,
+        "measured_seconds": round(m_compute, 9)
+        if m_compute is not None else None,
+        "ratio": round(m_compute / pred_compute, 4)
+        if pred_compute and m_compute is not None else None,
+    }
+    return out
+
+
+# --------------------------------------------------------------------------
+# baselines: load / check / update-with-justification
+# --------------------------------------------------------------------------
+
+
+def baseline_path(key: str, baselines_dir: Optional[Path] = None) -> Path:
+    stem = Path(key).name
+    if stem.endswith(".json"):
+        stem = stem[: -len(".json")]
+    return (baselines_dir or BASELINES_DIR) / f"{stem}.json"
+
+
+def load_baseline(key: str, baselines_dir: Optional[Path] = None
+                  ) -> Optional[dict[str, Any]]:
+    path = baseline_path(key, baselines_dir)
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def _round_floats(v: Any, nd: int = 6) -> Any:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, float):
+        return round(v, nd)
+    if isinstance(v, Mapping):
+        return {k: _round_floats(x, nd) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_round_floats(x, nd) for x in v]
+    return v
+
+
+def write_baseline(key: str, facts: Mapping[str, Any], *,
+                   justifications: list[str],
+                   noise: Optional[Mapping[str, float]] = None,
+                   baselines_dir: Optional[Path] = None) -> Path:
+    """Byte-stable snapshot write (sorted keys, fixed indent, rounded
+    floats) — reruns with identical measurements produce identical files."""
+    path = baseline_path(key, baselines_dir)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "comment": "perf-contract baseline — regenerate with "
+                   "tools/perf_contract.py --update-baselines; a regression "
+                   "beyond the noise bands must carry a --justify line "
+                   "(the ratchet only improves silently)",
+        "key": Path(key).name.removesuffix(".json"),
+        "justifications": list(justifications),
+        "noise": dict(sorted(dict(DEFAULT_NOISE, **(noise or {})).items())),
+        "facts": _round_floats(dict(facts)),
+    }
+    path.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+    return path
+
+
+def check_perf(key: str, facts: Mapping[str, Any], *,
+               baselines_dir: Optional[Path] = None,
+               noise: Optional[Mapping[str, float]] = None) -> AuditReport:
+    """The ratchet's read side: diff fresh facts against the committed
+    baseline (PC000 when none exists — plus the baseline-independent
+    calibration check, which needs no snapshot to fire)."""
+    name = Path(key).name.removesuffix(".json")
+    snap = load_baseline(key, baselines_dir)
+    if snap is None:
+        report = AuditReport(config=name)
+        report.add(
+            "PC000", "error",
+            f"no committed perf baseline for {name!r} "
+            f"({baseline_path(key, baselines_dir)})",
+            hint="baseline it: tools/perf_contract.py --update-baselines "
+                 "<facts source> --key " + name,
+        )
+        calibration_findings(facts, dict(DEFAULT_NOISE, **(noise or {})),
+                             report)
+        report.stats["no_baseline"] = True
+        return report
+    bands = dict(DEFAULT_NOISE, **(snap.get("noise") or {}), **(noise or {}))
+    report = diff_facts(snap.get("facts") or {}, facts, noise=bands,
+                        config_name=name)
+    report.stats["baseline_path"] = str(baseline_path(key, baselines_dir))
+    return report
+
+
+def update_baseline(key: str, facts: Mapping[str, Any], *,
+                    justify: Optional[str] = None,
+                    baselines_dir: Optional[Path] = None,
+                    noise: Optional[Mapping[str, float]] = None
+                    ) -> tuple[Path, AuditReport]:
+    """The ratchet's write side.
+
+    Improving (or in-band) facts commit silently, keeping existing
+    justifications.  A REGRESSION — any error finding against the committed
+    baseline — refuses to commit unless ``justify`` explains it; the
+    justification is recorded in-file."""
+    name = Path(key).name.removesuffix(".json")
+    snap = load_baseline(key, baselines_dir)
+    old_just = list((snap or {}).get("justifications")
+                    or ["initial perf baseline"])
+    old_noise = dict((snap or {}).get("noise") or {})
+    bands = dict(DEFAULT_NOISE, **old_noise, **(noise or {}))
+    if snap is None:
+        rep = AuditReport(config=name)
+        calibration_findings(facts, bands, rep)
+    else:
+        rep = diff_facts(snap.get("facts") or {}, facts, noise=bands,
+                         config_name=name)
+    if rep.failed("error") and not justify:
+        rules = sorted({f.rule for f in rep.findings
+                        if f.severity == "error"})
+        raise PerfContractError(
+            f"{name}: the new measurement REGRESSES the committed baseline "
+            f"({', '.join(rules)}) — a regression must be declared: pass "
+            f"--justify '<why>' (the ratchet only improves silently)\n"
+            f"{rep.format()}"
+        )
+    justifications = old_just + (
+        [justify] if justify and (rep.failed("error") or snap is None) else [])
+    path = write_baseline(key, facts, justifications=justifications,
+                          noise=dict(old_noise, **(noise or {})),
+                          baselines_dir=baselines_dir)
+    return path, rep
+
+
+def verdict_of(report: AuditReport) -> str:
+    """One report -> one verdict word: ``no_baseline`` when the ONLY
+    finding is the missing snapshot, else the worst severity (``clean``
+    when none).  The single derivation the bench line and the CLI share —
+    the two surfaces must never disagree about what a report means."""
+    if report.stats.get("no_baseline") \
+            and {f.rule for f in report.findings} <= {"PC000"}:
+        return "no_baseline"
+    return report.worst() or "clean"
+
+
+def bench_verdict(key: str, facts: Mapping[str, Any], *,
+                  baselines_dir: Optional[Path] = None) -> dict[str, Any]:
+    """The compact contract-verdict block every bench headline line must
+    carry (``bench.py`` refuses to emit one without it): the key checked,
+    ``no_baseline`` / ``clean`` / ``info`` / ``error``, and the named
+    findings when any fired."""
+    report = check_perf(key, facts, baselines_dir=baselines_dir)
+    no_baseline = bool(report.stats.get("no_baseline"))
+    out: dict[str, Any] = {
+        "key": Path(key).name.removesuffix(".json"),
+        "verdict": verdict_of(report),
+    }
+    findings = [{"rule": f.rule, "message": f.message}
+                for f in report.findings
+                if f.severity == "error" and f.rule != "PC000"]
+    if findings:
+        out["findings"] = findings
+    if no_baseline:
+        out["no_baseline"] = True
+    return out

@@ -103,6 +103,11 @@ class PlanReport:
     #: comms axes); "measured" marks a telemetry.trace calibration vs the
     #: topology-table prior
     overlap: Optional[dict] = None
+    #: measured facts the calibration source carried beyond overlap
+    #: (exposed collective seconds, measured pipeline bubble fraction) —
+    #: the audit trail that keeps planner priors auditable, not trusted
+    #: (analysis.perf_contract residuals; docs/observability.md)
+    calibration_facts: Optional[dict] = None
 
     @property
     def winner(self) -> Optional[PlanCandidate]:
@@ -123,6 +128,8 @@ class PlanReport:
         if self.overlap is not None:
             d["overlap"] = {k: (round(v, 4) if isinstance(v, float) else v)
                             for k, v in self.overlap.items()}
+        if self.calibration_facts is not None:
+            d["calibration_facts"] = self.calibration_facts
         w = self.winner
         d["winner"] = dataclasses.asdict(w.plan) if w else None
         if self.error:
@@ -166,6 +173,21 @@ class PlanReport:
                 f"{k}={v:.2f}" for k, v in sorted(self.overlap.items())
                 if isinstance(v, float))
             lines.append(f"comms overlap ({src}): {axes}")
+        cf = self.calibration_facts or {}
+        if cf:
+            bits = []
+            if cf.get("exposed_collective_seconds") is not None:
+                bits.append(f"exposed_collective_seconds="
+                            f"{cf['exposed_collective_seconds']:.4g}")
+            if cf.get("bubble_fraction_measured") is not None:
+                bits.append(f"bubble_fraction_measured="
+                            f"{cf['bubble_fraction_measured']:.4g}")
+            if cf.get("winner_bubble_residual") is not None:
+                bits.append(f"winner bubble residual "
+                            f"{cf['winner_bubble_residual']:+.4g} "
+                            f"(measured - predicted)")
+            if bits:
+                lines.append("calibration audit: " + ", ".join(bits))
         if self.error:
             lines.append(f"ERROR: {self.error}")
             return "\n".join(lines)
@@ -352,15 +374,40 @@ def plan_config(
         device=_first_device())
     overlap = None
     measured = False
+    calibration_facts: Optional[dict] = None
     if calibration is not None:
+        from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
+            load_trace_summary,
+        )
+
         try:
-            overlap = overlap_from_trace_summary(calibration)
+            # load once: overlap_from_trace_summary accepts the loaded dict,
+            # and the calibration-facts audit below reads the same payload
+            summary = load_trace_summary(calibration)
+            overlap = overlap_from_trace_summary(summary)
             measured = True
         except (OSError, ValueError) as e:
             return PlanReport(config=name, chips=chips, topology=topo.name,
                               candidates=[], n_plans=0, n_fit=0, facts=facts,
                               error=f"overlap calibration failed: "
                                     f"{type(e).__name__}: {e}")
+        try:
+            # the calibration source's measured facts beyond overlap — the
+            # audit trail (exposed seconds, measured bubble) that lets the
+            # report show the priors AND what contradicts them
+            pipe = summary.get("pipeline") or {}
+            calibration_facts = {
+                k: v for k, v in {
+                    "achieved_overlap": summary.get("achieved_overlap"),
+                    "exposed_collective_seconds": summary.get(
+                        "exposed_collective_seconds"),
+                    "bubble_fraction_measured": pipe.get(
+                        "bubble_fraction_measured"),
+                    "schedule_measured": pipe.get("schedule"),
+                }.items() if v is not None
+            } or None
+        except Exception as e:  # noqa: BLE001 — the audit trail is advisory
+            logger.debug("calibration facts unavailable: %s", e)
     overlap_used = dict(resolve_overlap(overlap, topo), measured=measured)
     ranked, n_plans, n_fit = rank_plans(
         facts, chips, topo, hbm_headroom=hbm_headroom, max_mbs=max_mbs,
@@ -369,6 +416,7 @@ def plan_config(
         return PlanReport(config=name, chips=chips, topology=topo.name,
                           candidates=[], n_plans=0, n_fit=0, facts=facts,
                           overlap=overlap_used,
+                          calibration_facts=calibration_facts,
                           error="no legal plan for this chip count "
                                 "(check divisibility of heads/layers/batch)")
     if audit:
@@ -377,9 +425,23 @@ def plan_config(
                                       max_devices=max_devices)
     else:
         candidates = ranked[:top_k]
-    return PlanReport(config=name, chips=chips, topology=topo.name,
-                      candidates=candidates, n_plans=n_plans, n_fit=n_fit,
-                      facts=facts, overlap=overlap_used)
+    report = PlanReport(config=name, chips=chips, topology=topo.name,
+                        candidates=candidates, n_plans=n_plans, n_fit=n_fit,
+                        facts=facts, overlap=overlap_used,
+                        calibration_facts=calibration_facts)
+    w = report.winner
+    if calibration_facts is not None and w is not None \
+            and calibration_facts.get("bubble_fraction_measured") is not None \
+            and w.estimate.step_seconds > 0:
+        # audit the winner's bubble price against the measured fraction —
+        # the residual analysis.perf_contract's PC302 gates on
+        predicted = w.estimate.bubble_seconds / w.estimate.step_seconds
+        calibration_facts["winner_bubble_fraction_predicted"] = round(
+            predicted, 6)
+        calibration_facts["winner_bubble_residual"] = round(
+            float(calibration_facts["bubble_fraction_measured"]) - predicted,
+            6)
+    return report
 
 
 def _first_device():

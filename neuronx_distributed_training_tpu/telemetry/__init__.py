@@ -35,6 +35,10 @@ from neuronx_distributed_training_tpu.telemetry.spans import (
     NON_PRODUCTIVE_SPANS,
     SpanTimer,
 )
+from neuronx_distributed_training_tpu.telemetry.step_timeline import (
+    analyze_pipeline,
+    pipeline_facts,
+)
 from neuronx_distributed_training_tpu.telemetry.trace import (
     TraceCapture,
     TraceConfig,
@@ -57,10 +61,12 @@ __all__ = [
     "TelemetryConfig",
     "TraceCapture",
     "TraceConfig",
+    "analyze_pipeline",
     "analyze_trace_dir",
     "compile_census",
     "grad_group_of",
     "load_trace_summary",
     "memory_analysis_bytes",
+    "pipeline_facts",
     "trace_steps",
 ]

@@ -179,12 +179,17 @@ class TraceCapture:
     _OWNER = "telemetry.trace"
 
     def __init__(self, cfg: TraceConfig, out_dir: str | Path, *,
-                 top_k: int = 15):
+                 top_k: int = 15,
+                 pipeline: Optional[Mapping[str, Any]] = None):
         self.cfg = cfg
         self.out_dir = Path(out_dir)
         self.raw_dir = self.out_dir / "trace"
         self.summary_path = self.out_dir / "trace_summary.json"
         self.top_k = top_k
+        # schedule facts (telemetry.step_timeline.pipeline_facts) — the
+        # trainer sets them once the schedule resolves; pp > 1 turns the
+        # analyzed summary's "pipeline" section on
+        self.pipeline = dict(pipeline) if pipeline else None
         self.active = False
         self.done = False
         self.summary: Optional[dict[str, Any]] = None
@@ -224,7 +229,8 @@ class TraceCapture:
                 analyze_trace_dir,
             )
 
-            self.summary = analyze_trace_dir(self.raw_dir, top_k=self.top_k)
+            self.summary = analyze_trace_dir(self.raw_dir, top_k=self.top_k,
+                                             pipeline=self.pipeline)
             self.summary["window"] = {
                 "start_step": self.cfg.start_step,
                 "num_steps": self.cfg.num_steps,
@@ -250,7 +256,8 @@ class TraceCapture:
 
 def trace_steps(step_fn, num_steps: int, out_dir: str | Path, *,
                 top_k: int = 15, keep_raw: bool = False,
-                owner: str = "telemetry.trace_steps"
+                owner: str = "telemetry.trace_steps",
+                pipeline: Optional[Mapping[str, Any]] = None
                 ) -> Optional[dict[str, Any]]:
     """Capture ``num_steps`` calls of ``step_fn(step)`` under one trace
     window and return the analyzed summary (None when the profiler session
@@ -275,7 +282,7 @@ def trace_steps(step_fn, num_steps: int, out_dir: str | Path, *,
             analyze_trace_dir,
         )
 
-        return analyze_trace_dir(out_dir, top_k=top_k)
+        return analyze_trace_dir(out_dir, top_k=top_k, pipeline=pipeline)
     except Exception as e:  # noqa: BLE001 — a failed parse is a None, not a crash
         logger.warning("trace analysis failed: %s", e)
         return None

@@ -239,7 +239,9 @@ def _overlap_us(start: float, end: float,
 
 
 def analyze_events(events: Iterable[dict], *, top_k: int = 15,
-                   source: Optional[str] = None) -> dict[str, Any]:
+                   source: Optional[str] = None,
+                   pipeline: Optional[Mapping[str, Any]] = None
+                   ) -> dict[str, Any]:
     """The full device-time summary (the ``trace_summary.json`` payload).
 
     Overlap definition: a collective interval's *hidden* device time is its
@@ -247,6 +249,11 @@ def analyze_events(events: Iterable[dict], *, top_k: int = 15,
     device lane (concurrent collectives do not hide each other);
     ``exposed = wire - hidden`` and ``achieved_overlap = hidden / wire``
     per collective class and overall.
+
+    ``pipeline`` — the run's schedule facts
+    (``telemetry.step_timeline.pipeline_facts``); when they say pp > 1 the
+    summary additionally carries the reconstructed ``"pipeline"`` section
+    (per-stage busy/idle, tick Gantt, measured bubble fraction).
     """
     events = list(events)
     ops = parse_op_events(events)
@@ -345,14 +352,23 @@ def analyze_events(events: Iterable[dict], *, top_k: int = 15,
         "top_ops": top_ops,
         "steps": steps,
     }
+    if pipeline is not None:
+        from neuronx_distributed_training_tpu.telemetry.step_timeline import (
+            analyze_pipeline,
+        )
+
+        section = analyze_pipeline(events, facts=pipeline)
+        if section is not None:
+            summary["pipeline"] = section
     return summary
 
 
-def analyze_trace_dir(path: str | os.PathLike, *, top_k: int = 15
+def analyze_trace_dir(path: str | os.PathLike, *, top_k: int = 15,
+                      pipeline: Optional[Mapping[str, Any]] = None
                       ) -> dict[str, Any]:
     """Parse + analyze a capture directory (or one trace file) in one call."""
     return analyze_events(load_trace_events(path), top_k=top_k,
-                          source=os.fspath(path))
+                          source=os.fspath(path), pipeline=pipeline)
 
 
 def load_trace_summary(path: str | os.PathLike) -> dict[str, Any]:

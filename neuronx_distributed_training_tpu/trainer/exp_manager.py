@@ -264,6 +264,16 @@ class ExpManager:
             self._profiling = False
             stop_session(self._PROFILE_OWNER)
 
+    def set_pipeline_facts(self, facts: Optional[dict[str, Any]]) -> None:
+        """Arm the trace capture's pipeline-timeline reconstruction with the
+        resolved schedule facts (``telemetry.step_timeline.pipeline_facts``).
+        The trainer calls this once the schedule is known; with pp > 1 the
+        next closed trace window carries the ``"pipeline"`` section and
+        ``bubble_fraction_measured`` lands in ``run_summary.json`` next to
+        the predicted fraction."""
+        if self._trace is not None:
+            self._trace.pipeline = dict(facts) if facts else None
+
     def maybe_trace(self, step: int) -> None:
         """Advance the ``telemetry.trace`` capture window (no-op when the
         knob is off).  When the window closes, the analyzed summary is in
@@ -283,14 +293,29 @@ class ExpManager:
         return self._trace is not None and self._trace.active
 
     def _record_trace_summary(self, summary: dict[str, Any]) -> None:
-        self.write_run_summary({"trace": {
+        section: dict[str, Any] = {"trace": {
             "achieved_overlap": summary.get("achieved_overlap"),
             "exposed_collective_seconds": summary.get(
                 "exposed_collective_seconds"),
             "collective_seconds": summary.get("collective_seconds"),
             "window": summary.get("window"),
             "summary_path": str(self._trace.summary_path),
-        }})
+        }}
+        pipe = summary.get("pipeline")
+        if isinstance(pipe, dict):
+            # the MEASURED bubble fraction is a run fact: it lives at the
+            # top level of run_summary.json beside bubble_fraction_predicted
+            # (the compile-census run fact), plus a compact pipeline block
+            section["bubble_fraction_measured"] = pipe.get(
+                "bubble_fraction_measured")
+            section["trace"]["pipeline"] = {
+                k: pipe.get(k)
+                for k in ("schedule", "bubble_fraction_measured",
+                          "bubble_fraction_predicted", "bubble_residual",
+                          "straggler_stage", "lane_resolution", "num_lanes")
+                if pipe.get(k) is not None
+            }
+        self.write_run_summary(section)
 
     # -- per-step hooks -----------------------------------------------------
 

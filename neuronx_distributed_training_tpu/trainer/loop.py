@@ -841,6 +841,18 @@ class Trainer:
                 pp_schedule, pp, int(sched["num_microbatches"]),
                 int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)), 6),
         }
+        # arm the trace capture's pipeline-timeline reconstruction: with
+        # pp > 1 a closed telemetry.trace window reconstructs the per-stage
+        # tick Gantt and writes bubble_fraction_measured beside the
+        # predicted run fact (telemetry.step_timeline)
+        from neuronx_distributed_training_tpu.telemetry.step_timeline import (
+            pipeline_facts,
+        )
+
+        exp.set_pipeline_facts(pipeline_facts(
+            pp_schedule, pp, int(sched["num_microbatches"]),
+            int(mesh_cfg.virtual_pipeline_model_parallel_size or 1),
+            run_facts["bubble_fraction_predicted"]))
         try:
             fwd_flops = _perf.flops_for_model(model_cfg, seq_len)
             run_facts["fwd_flops_per_token"] = fwd_flops

@@ -235,6 +235,46 @@ def trace_section(trace: dict) -> str:
     return "\n".join(lines)
 
 
+def provenance_section(summary: dict) -> str:
+    """Bench provenance (bench.py acquire_device): the acquire mode, the
+    watchdog phase tag actually reached, PJRT handshake timing, and backend
+    identity — what makes a dead bench round diagnosable from its JSON
+    artifact alone."""
+    prov = summary.get("provenance")
+    if not isinstance(prov, dict) or not prov:
+        return ""
+    lines = ["", "bench provenance (acquire/backend forensics)"]
+    for key in ("acquire_mode", "connect_phase", "requested_platform",
+                "platform", "device_kind", "jax_version",
+                "plugin_init_seconds", "first_rpc_seconds",
+                "probe_seconds", "probe_attempts",
+                "connect_timeout_seconds", "error"):
+        if prov.get(key) is not None:
+            v = prov[key]
+            lines.append(f"  {key:<22} "
+                         f"{_fmt(v) if isinstance(v, (int, float)) else v}")
+    return "\n".join(lines)
+
+
+def perf_contract_section(summary: dict) -> str:
+    """Perf-contract verdict (analysis.perf_contract): whether this line's
+    measured numbers were checked against the committed per-topology
+    baseline, and the named PC findings when any fired."""
+    pcv = summary.get("perf_contract")
+    if not isinstance(pcv, dict) or not pcv:
+        return ""
+    lines = ["", "perf contract (measured-runtime ratchet — "
+                 "docs/observability.md)"]
+    lines.append(f"  verdict               {pcv.get('verdict', '?')}"
+                 + (f"  (key {pcv['key']})" if pcv.get("key") else ""))
+    for f in pcv.get("findings") or []:
+        if isinstance(f, dict):
+            lines.append(f"    {f.get('rule', '?')}: {f.get('message', '')}")
+    if pcv.get("error"):
+        lines.append(f"  error                 {pcv['error']}")
+    return "\n".join(lines)
+
+
 def census_section(summary: dict) -> str:
     lines: list[str] = []
     if "compile_seconds" in summary:
@@ -252,6 +292,7 @@ def census_section(summary: dict) -> str:
                         or "none"))
     for key in ("model_family", "n_chips", "seq_len", "global_batch_size",
                 "pipeline_schedule", "bubble_fraction_predicted",
+                "bubble_fraction_measured",
                 "fwd_flops_per_token",
                 "train_step_flops_per_token", "peak_tflops_per_chip"):
         if summary.get(key) is not None:
@@ -287,6 +328,8 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(integrity_section(summary))
         parts.append(anomalies_section(summary))
         parts.append(census_section(summary))
+        parts.append(provenance_section(summary))
+        parts.append(perf_contract_section(summary))
     if trace_path and os.path.exists(trace_path):
         try:
             with open(trace_path) as f:

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Perf-contract CLI — the measured-runtime regression ratchet.
+
+Extracts canonical perf facts (step time, MFU/throughput, achieved overlap
+per collective class, exposed collective seconds, measured pipeline bubble
+fraction) from a measurement source and compares them against the committed
+per-topology baseline under
+``neuronx_distributed_training_tpu/analysis/perf_baselines/``:
+
+    python bench.py --platform cpu > /tmp/bench.json
+    python tools/perf_contract.py --check /tmp/bench.json
+    python tools/perf_contract.py --check <run_dir>           # trained run
+    python tools/perf_contract.py --update-baselines /tmp/bench.json
+    python tools/perf_contract.py --update-baselines /tmp/bench.json \
+        --justify "new remat default: +12% step time for -30% HBM"
+
+Accepted sources: a ``bench.py`` JSON line (file or stdout capture), a run
+dir (``run_summary.json`` + ``metrics.jsonl`` + ``trace_summary.json``), a
+bare ``trace_summary.json``, or a ``.jsonl`` whose last line is a bench
+record.  The baseline key defaults to the facts' device identity
+(``--key`` overrides).
+
+``--check`` fails (exit 1) on any regression beyond the baseline's noise
+bands: step time (PC101), MFU/throughput (PC102), per-class achieved
+overlap (PC201), exposed collective seconds naming the collective class
+(PC202), measured bubble growth (PC301), measured-vs-predicted bubble
+outside the calibration band (PC302), or cost-model residual drift (PC401)
+— each explained in subsystem terms (docs/observability.md
+"Perf contracts").  A missing baseline is PC000 unless ``--allow-missing``
+(the bench smoke's bootstrap mode) downgrades it to a warning.
+
+``--update-baselines`` is the ratchet's write side: improvements commit
+silently; a REGRESSION refuses to commit without ``--justify`` (recorded
+in-file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("source", nargs="+",
+                    help="measurement source(s): bench JSON line file, run "
+                         "dir, trace_summary.json, or .jsonl evidence log")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true", default=True,
+                      help="diff against the committed baseline (default)")
+    mode.add_argument("--update-baselines", action="store_true",
+                      help="rewrite the committed baseline(s); a regression "
+                           "requires --justify")
+    ap.add_argument("--key", metavar="NAME",
+                    help="baseline key (default: derived from the facts' "
+                         "device identity, e.g. cpu_bench)")
+    ap.add_argument("--justify", metavar="TEXT",
+                    help="in-file justification for a baseline regression "
+                         "(--update-baselines)")
+    ap.add_argument("--noise", action="append", default=[],
+                    metavar="BAND=VALUE",
+                    help="noise-band override recorded into the baseline "
+                         "on update (repeatable), e.g. --noise "
+                         "step_time_frac=1.5 for a CPU smoke whose wall "
+                         "clock varies across machines")
+    ap.add_argument("--baselines-dir", metavar="DIR",
+                    help="baseline directory override (default: the "
+                         "committed analysis/perf_baselines/)")
+    ap.add_argument("--allow-missing", action="store_true",
+                    help="a missing baseline is a warning, not a failure "
+                         "(bootstrap mode for fresh topologies)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="machine-readable report ('-' for stdout, "
+                         "guaranteed last line)")
+    args = ap.parse_args(argv)
+
+    from neuronx_distributed_training_tpu.analysis import perf_contract as pc
+    from neuronx_distributed_training_tpu.analysis.report import AuditReport
+
+    bdir = Path(args.baselines_dir) if args.baselines_dir else None
+    noise = {}
+    for spec in args.noise:
+        band, _, value = spec.partition("=")
+        if band not in pc.DEFAULT_NOISE:
+            ap.error(f"unknown noise band {band!r}; supported: "
+                     f"{sorted(pc.DEFAULT_NOISE)}")
+        try:
+            noise[band] = float(value)
+        except ValueError:
+            ap.error(f"--noise {spec!r}: value must be a number")
+    failed = False
+    out: dict = {"reports": []}
+    for source in args.source:
+        try:
+            facts = pc.load_facts(source)
+        except pc.PerfContractError as e:
+            rep = AuditReport(config=str(source))
+            rep.add("PC000", "error", str(e),
+                    hint="point at a bench JSON line, a run dir, or a "
+                         "trace_summary.json")
+            print(rep.format())
+            out["reports"].append(rep.to_dict())
+            failed = True
+            continue
+        key = args.key or pc.default_key(facts)
+        if args.update_baselines:
+            try:
+                path, rep = pc.update_baseline(
+                    key, facts, justify=args.justify, baselines_dir=bdir,
+                    noise=noise or None)
+                drift = rep.by_severity() or "no drift"
+                print(f"perf baseline [{key}]: updated -> {path} ({drift})")
+            except pc.PerfContractError as e:
+                print(f"perf baseline [{key}]: REFUSED: {e}")
+                failed = True
+                out["reports"].append({"config": key, "verdict": "error",
+                                       "refused": str(e)})
+                continue
+        else:
+            rep = pc.check_perf(key, facts, baselines_dir=bdir,
+                                noise=noise or None)
+            no_baseline = bool(rep.stats.get("no_baseline"))
+            print(f"perf contract [{key}]: {pc.verdict_of(rep)}")
+            if rep.findings:
+                print(rep.format())
+            print()
+            if no_baseline and args.allow_missing:
+                if {f.rule for f in rep.findings} <= {"PC000"}:
+                    pass  # bootstrap: nothing but the missing snapshot
+                else:
+                    failed = True
+            else:
+                failed |= rep.failed("error")
+        rep_dict = rep.to_dict()
+        rep_dict["key"] = key
+        rep_dict["facts"] = facts
+        out["reports"].append(rep_dict)
+
+    if args.json:
+        from _jsonout import write_json
+
+        write_json(out, args.json)
+
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -137,6 +137,57 @@ def render(summary: dict, *, top: int = 10) -> str:
         parts.append(f"\ntop {len(rows)} ops by device time\n" + _table(
             rows, ("op", "class", "n", "total", "mean_us", "share")))
 
+    pipe = summary.get("pipeline") or {}
+    if pipe:
+        mb = pipe.get("bubble_fraction_measured")
+        pb = pipe.get("bubble_fraction_predicted")
+        head = (f"\npipeline timeline ({pipe.get('schedule')} pp="
+                f"{pipe.get('pp')} nm={pipe.get('num_microbatches')} "
+                f"vp={pipe.get('vp')}, "
+                f"{pipe.get('lane_resolution')} lanes)")
+        lines = [head]
+        if mb is not None:
+            lines.append(
+                f"  bubble_fraction_measured  {100 * float(mb):.2f}%"
+                + (f"  (predicted {100 * float(pb):.2f}%, residual "
+                   f"{100 * (float(mb) - float(pb)):+.2f}%)"
+                   if pb is not None else ""))
+        if pipe.get("straggler_stage"):
+            lines.append(
+                f"  straggler_stage           {pipe['straggler_stage']} "
+                f"({100 * pipe.get('straggler_busy_fraction', 0.0):.1f}% "
+                f"busy)")
+        stages = pipe.get("stages") or {}
+        if stages:
+            rows = [
+                (lane, s.get("ticks_detected", 0),
+                 _fmt_s(s.get("busy_seconds", 0.0)),
+                 _fmt_s(s.get("idle_seconds", 0.0)),
+                 f"{100 * s.get('busy_fraction', 0.0):.1f}%",
+                 _fmt_s(s.get("collective_seconds", 0.0)))
+                for lane, s in sorted(stages.items(),
+                                      key=lambda kv: kv[1].get("stage", 0))
+            ]
+            lines.append(_table(rows, ("stage", "ticks", "busy", "idle",
+                                       "busy%", "collective")))
+        ticks = pipe.get("ticks") or []
+        if ticks:
+            # one ASCII Gantt row per stage: each tick a busy-level glyph
+            by_stage: dict = {}
+            for t in ticks:
+                by_stage.setdefault(t.get("stage", 0), []).append(
+                    t.get("busy_fraction", 0.0))
+            glyphs = " .:-=#"
+            lines.append("  tick gantt (busy per tick, ' '=idle '#'=full"
+                         + (", truncated)" if pipe.get("ticks_truncated")
+                            else ")"))
+            for stage, fracs in sorted(by_stage.items()):
+                bar = "".join(
+                    glyphs[min(int(f * (len(glyphs) - 1) + 0.5),
+                               len(glyphs) - 1)] for f in fracs)
+                lines.append(f"    stage {stage}  |{bar}|")
+        parts.append("\n".join(lines))
+
     steps = summary.get("steps") or {}
     if steps:
         rows = [
