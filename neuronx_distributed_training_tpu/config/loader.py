@@ -406,10 +406,14 @@ def validate_config(cfg: ConfigDict) -> None:
 
     # ---- exp_manager.telemetry -------------------------------------------
     # the unified step-telemetry knob block (spans/mfu/compile_census/
-    # device_memory/goodput) plus the nested ``health`` flight-recorder block
-    # (enabled/policy/ring_buffer_steps/watchdog_*; HealthConfig validates it
-    # through the same call); a typo'd knob or policy must die here, not
-    # silently run with defaults
+    # device_memory/goodput/batch_stats) plus the nested blocks — ``health``
+    # (flight recorder: enabled/policy/ring_buffer_steps/watchdog_*),
+    # ``trace`` (windowed device-time capture), ``fleet`` (per-host beacons
+    # + aggregation: enabled/stale_after_seconds/aggregate/max_windows), and
+    # the ``alerts`` rule list (metric/window/threshold|below|rel_drop/
+    # action) — each validated by its own parser through this one call; a
+    # typo'd knob, policy, or alert rule must die here, not silently run
+    # with defaults (or silently never alert)
     em = cfg.get("exp_manager", {}) or {}
     if isinstance(em, Mapping) and "telemetry" in em:
         from neuronx_distributed_training_tpu.telemetry import TelemetryConfig

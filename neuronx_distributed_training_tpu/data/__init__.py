@@ -16,10 +16,12 @@ from neuronx_distributed_training_tpu.data.packing import (  # noqa: F401
     pad_sequences,
 )
 from neuronx_distributed_training_tpu.data.loader import (  # noqa: F401
+    BatchStats,
     DataModule,
     DataStallError,
     HFDataModule,
     PrefetchIterator,
     SyntheticDataModule,
+    batch_token_stats,
     process_global_batch,
 )

@@ -139,6 +139,96 @@ class PrefetchIterator:
         self._stop.set()
 
 
+def batch_token_stats(
+    batch: dict[str, np.ndarray], *, pad_id: Optional[int] = None
+) -> dict[str, float]:
+    """Per-global-batch data-pipeline stats from the HOST numpy batch
+    (docs/observability.md "Data-pipeline stats").
+
+    - ``data/padding_fraction``: fraction of token positions contributing
+      nothing — ``input_ids == pad_id`` when the pad token is known, else
+      ``loss_mask == 0`` positions (which for SFT also counts masked prompt
+      tokens; the glossary documents the distinction).
+    - ``data/packing_efficiency``: mean effective row length / row width,
+      where effective length is the index of the last active position + 1 —
+      how much of each row the packer actually filled (1.0 = fully packed).
+    - ``data/seq_len_{mean,p50,min,max}``: the per-row effective-length
+      spread (the histogram summary a terminal can read).
+
+    Computed host-side from the already-materialized batch — zero device
+    work; the accumulator below runs it on the prefetch thread so not even
+    host time lands between dispatches.
+    """
+    ids = batch.get("input_ids")
+    if ids is None:
+        return {}
+    ids = np.asarray(ids)
+    if ids.ndim != 2 or ids.size == 0:
+        return {}
+    if pad_id is not None:
+        active = ids != pad_id
+    elif "loss_mask" in batch:
+        active = np.asarray(batch["loss_mask"]) > 0
+    else:
+        active = np.ones_like(ids, dtype=bool)
+    rows, width = active.shape
+    # effective length: last active position + 1 (0 for an all-pad row)
+    any_active = active.any(axis=1)
+    last = width - 1 - np.argmax(active[:, ::-1], axis=1)
+    eff = np.where(any_active, last + 1, 0).astype(np.float64)
+    return {
+        "data/padding_fraction": float(1.0 - active.mean()),
+        "data/packing_efficiency": float(eff.mean() / width),
+        "data/seq_len_mean": float(eff.mean()),
+        "data/seq_len_p50": float(np.median(eff)),
+        "data/seq_len_min": float(eff.min()),
+        "data/seq_len_max": float(eff.max()),
+    }
+
+
+class BatchStats:
+    """Thread-safe accumulator of :func:`batch_token_stats` across the
+    batches between two logging boundaries.
+
+    The prefetch thread calls :meth:`update` per global batch (inside
+    ``DataModule.global_batches``); the trainer drains the running means at
+    each boundary into the metric stream.  Means average across batches;
+    min/max extremes survive the window."""
+
+    def __init__(self, *, pad_id: Optional[int] = None) -> None:
+        self.pad_id = pad_id
+        self._lock = threading.Lock()
+        self._sums: dict[str, float] = {}
+        self._mins: dict[str, float] = {}
+        self._maxs: dict[str, float] = {}
+        self._n = 0
+
+    def update(self, batch: dict[str, np.ndarray]) -> None:
+        stats = batch_token_stats(batch, pad_id=self.pad_id)
+        if not stats:
+            return
+        with self._lock:
+            self._n += 1
+            for k, v in stats.items():
+                self._sums[k] = self._sums.get(k, 0.0) + v
+                if k.endswith("_min"):
+                    self._mins[k] = min(self._mins.get(k, v), v)
+                elif k.endswith("_max"):
+                    self._maxs[k] = max(self._maxs.get(k, v), v)
+
+    def drain(self) -> dict[str, float]:
+        """Stats for the batches seen since the last drain ({} when none)."""
+        with self._lock:
+            if self._n == 0:
+                return {}
+            out = {k: v / self._n for k, v in self._sums.items()}
+            out.update(self._mins)
+            out.update(self._maxs)
+            self._sums, self._mins, self._maxs = {}, {}, {}
+            self._n = 0
+        return out
+
+
 def process_global_batch(
     batch: dict[str, np.ndarray],
     *,
@@ -211,6 +301,10 @@ class DataModule:
         self.global_batch_size = global_batch_size
         self.input_names = tuple(input_names)
         self.pad_id = pad_id
+        # data-pipeline stats hook (telemetry.batch_stats): the trainer
+        # attaches a BatchStats accumulator here; global_batches feeds it
+        # on the prefetch thread and the boundary drains it into metrics
+        self.batch_stats: Optional[BatchStats] = None
         if shuffle:
             self.sampler: Any = RandomSampler(
                 total_samples, global_batch_size, seed=seed, consumed_samples=consumed_samples
@@ -231,9 +325,12 @@ class DataModule:
     def global_batches(self) -> Iterator[dict[str, np.ndarray]]:
         """Yield processed host-side global batches (numpy)."""
         for idx in self.sampler:
-            yield process_global_batch(
+            batch = process_global_batch(
                 self.fetch_rows(idx), input_names=self.input_names, pad_id=self.pad_id
             )
+            if self.batch_stats is not None:
+                self.batch_stats.update(batch)
+            yield batch
 
     def sharded_batches(
         self, mesh: Mesh, spec: Optional[P] = None

@@ -13,9 +13,22 @@ path, which only runs once a step has already gone non-finite, is the one
 deliberate exception).
 """
 
+from neuronx_distributed_training_tpu.telemetry.alerts import (
+    ALERT_ACTIONS,
+    AlertEngine,
+    AlertRule,
+    parse_alerts,
+)
 from neuronx_distributed_training_tpu.telemetry.census import (
     compile_census,
     memory_analysis_bytes,
+)
+from neuronx_distributed_training_tpu.telemetry.fleet import (
+    FleetAggregator,
+    FleetBeacon,
+    FleetConfig,
+    FleetPlane,
+    aggregate_fleet,
 )
 from neuronx_distributed_training_tpu.telemetry.config import (
     TELEMETRY_KNOBS,
@@ -50,6 +63,13 @@ from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
 )
 
 __all__ = [
+    "ALERT_ACTIONS",
+    "AlertEngine",
+    "AlertRule",
+    "FleetAggregator",
+    "FleetBeacon",
+    "FleetConfig",
+    "FleetPlane",
     "HEALTH_POLICIES",
     "HangWatchdog",
     "HealthConfig",
@@ -61,9 +81,11 @@ __all__ = [
     "TelemetryConfig",
     "TraceCapture",
     "TraceConfig",
+    "aggregate_fleet",
     "analyze_pipeline",
     "analyze_trace_dir",
     "compile_census",
+    "parse_alerts",
     "grad_group_of",
     "load_trace_summary",
     "memory_analysis_bytes",

@@ -19,11 +19,21 @@ manager, and the config validator all agree on the schema:
           enabled: false
           start_step: 1
           num_steps: 3
+        fleet:                # per-host beacons + aggregation (telemetry.fleet)
+          enabled: false
+          stale_after_seconds: 600
+        alerts:               # declarative alert rules (telemetry.alerts)
+          - metric: data_wait
+            threshold: 30.0
+            action: halt
 
 Everything defaults ON except ``device_memory`` (``memory_stats()`` is a
 backend query some runtimes answer slowly), ``health`` (its anomaly
 counters live inside the optimizer state, so enabling it changes the
-checkpoint tree — an explicit opt-in), and ``trace`` (a profiler window
+checkpoint tree — an explicit opt-in), ``batch_stats`` (per-boundary
+data-pipeline stats cost an O(batch) numpy pass on the prefetch thread),
+``fleet``/``alerts`` (multi-host surfaces an operator opts into), and
+``trace`` (a profiler window
 has real capture overhead inside it) — the layer is designed to be
 cheap enough to leave on: span timing is ``time.perf_counter`` bookkeeping,
 MFU is arithmetic on the already-maintained throughput window, and the census
@@ -36,12 +46,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping
 
+from neuronx_distributed_training_tpu.telemetry.alerts import (
+    AlertRule,
+    parse_alerts,
+)
+from neuronx_distributed_training_tpu.telemetry.fleet import FleetConfig
 from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
 from neuronx_distributed_training_tpu.telemetry.trace import TraceConfig
 
 #: boolean knob name -> default; the single source of truth for schema
-#: validation (the nested ``health``/``trace`` blocks validate via their
-#: own dataclasses)
+#: validation (the nested ``health``/``trace``/``fleet``/``alerts`` blocks
+#: validate via their own dataclasses)
 TELEMETRY_KNOBS: dict[str, bool] = {
     "spans": True,
     "mfu": True,
@@ -54,7 +69,15 @@ TELEMETRY_KNOBS: dict[str, bool] = {
     # HLO text parsing at first compile only; off by default because large
     # programs make the text walk a noticeable one-time cost.
     "graph_audit": False,
+    # per-boundary data-pipeline stats (padding fraction, packing
+    # efficiency, seq-len spread) computed host-side on the prefetch thread
+    # from the already-materialized numpy batch (data.loader.BatchStats);
+    # off by default: an O(batch) numpy pass per global batch.
+    "batch_stats": False,
 }
+
+#: nested (non-boolean) telemetry blocks, each validated by its own parser
+_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,8 +88,11 @@ class TelemetryConfig:
     device_memory: bool = False
     goodput: bool = True
     graph_audit: bool = False
+    batch_stats: bool = False
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    alerts: tuple[AlertRule, ...] = ()
 
     @classmethod
     def from_config(cls, block: Any) -> "TelemetryConfig":
@@ -88,16 +114,16 @@ class TelemetryConfig:
         if not isinstance(block, Mapping):
             raise ValueError(
                 f"exp_manager.telemetry must be a mapping of "
-                f"{sorted(TELEMETRY_KNOBS) + ['health', 'trace']} (or a "
+                f"{sorted(TELEMETRY_KNOBS) + list(_NESTED_BLOCKS)} (or a "
                 f"single bool), got {type(block).__name__}"
             )
-        unknown = set(block) - set(TELEMETRY_KNOBS) - {"health", "trace"}
+        unknown = set(block) - set(TELEMETRY_KNOBS) - set(_NESTED_BLOCKS)
         if unknown:
             from neuronx_distributed_training_tpu.config.loader import (
                 did_you_mean,
             )
 
-            options = sorted(TELEMETRY_KNOBS) + ["health", "trace"]
+            options = sorted(TELEMETRY_KNOBS) + list(_NESTED_BLOCKS)
             raise ValueError(
                 f"unknown exp_manager.telemetry keys {sorted(unknown)}; "
                 f"supported: {options}" + did_you_mean(unknown, options)
@@ -110,6 +136,12 @@ class TelemetryConfig:
             if k == "trace":
                 values[k] = TraceConfig.from_config(v)
                 continue
+            if k == "fleet":
+                values[k] = FleetConfig.from_config(v)
+                continue
+            if k == "alerts":
+                values[k] = parse_alerts(v)
+                continue
             if not isinstance(v, bool):
                 raise ValueError(
                     f"exp_manager.telemetry.{k} must be a boolean, got {v!r}"
@@ -117,5 +149,5 @@ class TelemetryConfig:
             values[k] = v
         return cls(**values)
 
-    def to_dict(self) -> dict[str, bool]:
+    def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -31,7 +31,6 @@ session raises deep in teardown otherwise — the exact hazard the old
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import shutil
 import threading
@@ -235,9 +234,13 @@ class TraceCapture:
                 "start_step": self.cfg.start_step,
                 "num_steps": self.cfg.num_steps,
             }
-            with open(self.summary_path, "w") as f:
-                json.dump(self.summary, f, indent=1, sort_keys=True)
-                f.write("\n")
+            # atomic (temp + rename): a kill mid-write must not leave torn
+            # JSON for the report tools / perf-contract extraction to choke on
+            from neuronx_distributed_training_tpu.utils.io import (
+                atomic_write_json,
+            )
+
+            atomic_write_json(self.summary_path, self.summary)
             logger.info(
                 "device-time trace window closed: achieved_overlap=%s "
                 "exposed_collective_seconds=%s -> %s",

@@ -17,6 +17,7 @@ from pathlib import Path
 from typing import Any, Optional
 
 from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+from neuronx_distributed_training_tpu.utils.io import atomic_write_json
 from neuronx_distributed_training_tpu.utils.perf import Throughput, mfu as _mfu
 
 logger = logging.getLogger(__name__)
@@ -122,6 +123,10 @@ class ExpManager:
         self._summary_lock = threading.Lock()
         # set by set_mfu_reference: (train-step FLOPs/token, chips, peak TF/s)
         self._mfu_ref: Optional[tuple[float, int, float]] = None
+        # metric keys already warned about as non-scalar (warn ONCE per key:
+        # the sinks take scalars only, and silently dropping a value hides
+        # an instrumentation bug — but warning every boundary is log spam)
+        self._warned_nonscalar: set[str] = set()
 
         self.profile_start_step = profile_start_step
         self.profile_num_steps = profile_num_steps
@@ -360,7 +365,13 @@ class ExpManager:
     def write_run_summary(self, section: dict[str, Any]) -> None:
         """Merge ``section`` into ``run_summary.json`` (next to
         ``metrics.jsonl``): the one-shot facts of the run — compile census,
-        goodput totals — that don't belong in the per-step stream."""
+        goodput totals — that don't belong in the per-step stream.
+
+        The write is atomic (serialize, temp file, rename): a SIGKILL
+        mid-write — preemption, OOM-killer, the elastic drill's kill
+        injector — must never leave a truncated document for resume or
+        reporting to choke on, and an unserializable ``section`` raises
+        with the previous contents intact."""
         with self._summary_lock:
             existing: dict[str, Any] = {}
             try:
@@ -369,19 +380,37 @@ class ExpManager:
             except (OSError, ValueError):
                 pass
             existing.update(section)
-            with open(self._run_summary_file, "w") as f:
-                json.dump(existing, f, indent=1, sort_keys=True)
-                f.write("\n")
+            atomic_write_json(self._run_summary_file, existing)
 
     def log_metrics(self, step: int, metrics: dict[str, Any], *, force: bool = False) -> None:
         """Write scalars (TB + jsonl) every ``log_every_n_steps``.
 
         Scalars logged mirror the reference's set: reduced_train_loss, lr,
         grad/param norm, throughput, throughput_peak, consumed_samples
-        (``base.py:624-654``)."""
+        (``base.py:624-654``).  Non-scalar values are coerced when they hold
+        exactly one element (0-d / size-1 arrays) and otherwise dropped with
+        a once-per-key warning naming the offender — every sink (TB, W&B,
+        MLflow, jsonl) takes scalars only, and a silent drop hides the
+        instrumentation bug that produced the value."""
         if not force and step % self.log_every_n_steps != 0:
             return
-        flat = {k: float(v) for k, v in metrics.items() if _is_scalar(v)}
+        flat: dict[str, float] = {}
+        for k, v in metrics.items():
+            f = _coerce_scalar(v)
+            if f is None:
+                if k not in self._warned_nonscalar:
+                    self._warned_nonscalar.add(k)
+                    shape = getattr(v, "shape", None)
+                    logger.warning(
+                        "log_metrics: dropping non-scalar metric %r "
+                        "(%s%s) — the TB/W&B/MLflow/jsonl sinks take "
+                        "scalars; log a reduction instead (warned once)",
+                        k, type(v).__name__,
+                        f", shape {tuple(shape)}" if shape is not None
+                        else "",
+                    )
+                continue
+            flat[k] = f
         if self._last_tput is not None:
             flat["throughput_seqs_per_sec"] = self._last_tput
             flat["throughput_peak"] = self.throughput.peak
@@ -430,8 +459,32 @@ class ExpManager:
 
 
 def _is_scalar(v: Any) -> bool:
+    return _coerce_scalar(v) is not None
+
+
+def _coerce_scalar(v: Any) -> Optional[float]:
+    """Host float from a scalar-like value, else None.
+
+    ``float()`` covers Python numbers and numpy/jax 0-d arrays / device
+    scalars; size-1 arrays of higher rank (``np.array([3.0])``) go through
+    ``item()`` (newer numpy deprecates ``float()`` on them).  Multi-element
+    arrays (and anything else) return None — the caller decides whether to
+    warn."""
+    if getattr(v, "ndim", 0):
+        if getattr(v, "size", 0) == 1:
+            try:
+                return float(v.item())
+            except (TypeError, ValueError):
+                return None
+        return None
     try:
-        float(v)
-        return True
+        return float(v)
     except (TypeError, ValueError):
-        return False
+        pass
+    item = getattr(v, "item", None)
+    if item is not None and getattr(v, "size", 0) == 1:
+        try:
+            return float(item())
+        except (TypeError, ValueError):
+            pass
+    return None

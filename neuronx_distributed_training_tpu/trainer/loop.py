@@ -1167,6 +1167,68 @@ class Trainer:
             if monitor is not None and hc.watchdog_timeout_seconds > 0
             else None
         )
+        # -- fleet observability plane + declarative alerts (telemetry.fleet
+        # / telemetry.alerts — docs/observability.md "Fleet observability"):
+        # this host appends a beacon to fleet/host_<id>.jsonl at every
+        # logging boundary; rank 0 folds every host's stream into
+        # fleet_summary.json (straggler attribution, quiet-host findings);
+        # the alert rules evaluate over the streamed boundary metrics.
+        # Everything is host-side bookkeeping on already-fetched values —
+        # zero new host syncs between boundaries, no graph changes.
+        fleet = None
+        if tel.fleet.enabled:
+            try:
+                from neuronx_distributed_training_tpu.telemetry import (
+                    FleetPlane,
+                )
+
+                host = int(jax.process_index())
+                fleet = FleetPlane(
+                    tel.fleet, self.exp.log_dir, host=host,
+                    aggregate=(host == 0),
+                    write_run_summary=self.exp.write_run_summary,
+                )
+            except Exception as e:  # noqa: BLE001 — observability must not
+                logger.warning("fleet plane unavailable: %s", e)
+        alerts = None
+        if tel.alerts:
+            from neuronx_distributed_training_tpu.telemetry import AlertEngine
+
+            alerts = AlertEngine(
+                tel.alerts, write_run_summary=self.exp.write_run_summary)
+            halt_rules = [r.name for r in tel.alerts if r.action == "halt"]
+            if halt_rules and jax.process_count() > 1:
+                # the stop decision is HOST-LOCAL: a halt rule on a
+                # host-local metric (spans, timing-derived throughput/mfu
+                # at the margin, or fleet/* which only rank 0 computes)
+                # can fire on one host while the rest keep dispatching
+                # toward a collective rendezvous that host will never
+                # join.  Only device-computed replicated metrics (loss,
+                # grad_norm, health/* — identical on every host) halt
+                # consistently everywhere.
+                logger.warning(
+                    "alert rules %s use action=halt in a multi-host run: "
+                    "halt is evaluated PER HOST — on a metric that is not "
+                    "bit-identical across hosts (spans, fleet/*, "
+                    "timing-derived mfu/throughput at the threshold "
+                    "margin), one host may stop alone and stall the fleet "
+                    "at the next collective; prefer replicated metrics "
+                    "(loss, grad_norm, health/*) for halt, and log/dump "
+                    "for host-local ones (docs/observability.md "
+                    "'Alert rules')", halt_rules)
+        if monitor is None and (
+                fleet is not None
+                or any(r.action == "dump" for r in tel.alerts)):
+            # alert `action: dump` and the fleet's quiet-host findings both
+            # reuse the flight recorder's bundle machinery; without the
+            # health knob on, arm a bundle-only monitor (ring + forensic
+            # writes — no in-graph probes, and with no health counters in
+            # the metrics its boundary check is a no-op)
+            monitor = HealthMonitor(
+                hc, dump_dir=self.exp.log_dir, run_facts=self.run_facts,
+                write_run_summary=self.exp.write_run_summary,
+                rng_seed=STEP_KEY_SEED,
+            )
         halted = False
 
         def _sync_guard(what):
@@ -1215,6 +1277,12 @@ class Trainer:
         resumed = False
         last_metrics: dict[str, float] = {}
         batches = None
+        # the exception actually propagating out of THIS fit() — captured
+        # explicitly because sys.exc_info() inside the finally would also
+        # see an exception the CALLER is currently handling (fit() invoked
+        # from an except block), mislabeling a clean run as a dying host in
+        # the final fleet beacon
+        fit_exc: Optional[BaseException] = None
         try:
             # the restart phase runs INSIDE the teardown scope: a restore
             # failure (corrupt checkpoint, drill restore-kill) must still
@@ -1246,6 +1314,23 @@ class Trainer:
                     # under policy=halt)
                     monitor.seed_counters(
                         int(self.opt_state["health"]["nonfinite_count"]))
+            # data-pipeline stats (telemetry.batch_stats): the accumulator
+            # rides the prefetch thread — global_batches feeds it from the
+            # host numpy batch before sharding, the boundary drains it into
+            # the metric stream.  Attached before the iterator exists so the
+            # first batch is already counted.
+            batch_stats = None
+            if tel.batch_stats and hasattr(self.data_module, "global_batches"):
+                from neuronx_distributed_training_tpu.data.loader import (
+                    BatchStats,
+                )
+
+                batch_stats = BatchStats(
+                    pad_id=getattr(self.data_module, "pad_id", None))
+                try:
+                    self.data_module.batch_stats = batch_stats
+                except AttributeError:  # a slotted test double: no hook
+                    batch_stats = None
             # background prefetch: slow fetch_rows (arrow page-in, mmap
             # faults) must not stall dispatch (the reference's MpDeviceLoader
             # role); shard_batch uses an explicit NamedSharding, so it is
@@ -1401,7 +1486,47 @@ class Trainer:
                             spans.goodput_fraction())
                     if tel.device_memory:
                         last_metrics.update(_device_memory_metrics(self.mesh))
+                    if batch_stats is not None and self.step % log_every == 0:
+                        # data/ stats the prefetch thread accumulated since
+                        # the last LOG boundary.  Drained only when
+                        # log_metrics will actually write the record — a
+                        # checkpoint/validation boundary off the log cadence
+                        # would otherwise reset the accumulator into a
+                        # record every sink drops
+                        last_metrics.update(batch_stats.drain())
                     self.exp.log_metrics(self.step, last_metrics)
+                    fleet_metrics: dict[str, float] = {}
+                    if fleet is not None:
+                        # this host's beacon + (rank 0) the fleet fold; a
+                        # newly quiet host dumps a fleet_stall bundle through
+                        # the flight recorder, and the returned fleet/*
+                        # metrics feed the alert rules below
+                        fleet_metrics = fleet.boundary(
+                            self.step, last_metrics,
+                            spans=(spans.snapshot() if spans.enabled
+                                   else None),
+                            monitor=monitor,
+                        )
+                    if alerts is not None:
+                        for fire in alerts.observe(
+                                self.step,
+                                {**last_metrics, **fleet_metrics}):
+                            if fire.action == "dump" and monitor is not None:
+                                # same forensic machinery as an anomaly:
+                                # alert_<step>/ bundle with the ring trail
+                                monitor.dump(
+                                    self.step, kind="alert",
+                                    boundary_metrics=last_metrics,
+                                    extra={"alert": fire.to_dict()},
+                                )
+                            elif fire.action == "halt":
+                                # operational halt (state is NOT poisoned):
+                                # the graceful-stop path checkpoints for
+                                # resume and the reason lands in
+                                # run_summary.json (elastic.stop_reason +
+                                # the alerts trail)
+                                _request_stop(
+                                    f"alert {fire.rule}: {fire.message}")
 
                     if halted:
                         break
@@ -1455,7 +1580,16 @@ class Trainer:
                         "preemption notice during the final save: run "
                         "already complete (%s)", self.preemption_notice)
                     self.preemption_notice = None
+        except BaseException as e:
+            fit_exc = e
+            raise
         finally:
+            if fleet is not None:
+                # final beacon FIRST (before the checkpoint drain can block):
+                # clean exit -> closing:true, a raising fit() -> the
+                # last_exception record, so the aggregator can tell a dead
+                # host from a quiet one.  close() never raises.
+                fleet.close(fit_exc, step=self.step)
             if batches is not None:
                 batches.close()
             if old_handler is not None:

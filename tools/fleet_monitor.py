@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Fold per-host fleet beacons into a terminal report (and fleet_summary.json).
+
+The cross-host answer to "which host is slow, which host is stalling data,
+which host went quiet" — everything the beacon plane (``telemetry.fleet``,
+docs/observability.md "Fleet observability") wrote, from one terminal:
+
+    python tools/fleet_monitor.py nxdt_experiments/hf_llama3_8B/version_0
+    python tools/fleet_monitor.py path/to/run_dir/fleet        # beacon dir
+    python tools/fleet_monitor.py run_dir --json -             # last line = JSON
+    python tools/fleet_monitor.py run_dir --write              # refresh
+                                                               # fleet_summary.json
+    python tools/fleet_monitor.py run_dir --live               # quiet-host
+                                                               # check vs NOW
+
+Accepts a run dir (aggregates its ``fleet/`` beacon files), a beacon
+directory itself, or an already-written ``fleet_summary.json`` (rendered
+as-is).  ``--json`` goes through the shared ``tools/_jsonout.py``
+single-last-line contract.  Offline aggregation anchors quiet-host
+detection to the fleet's newest beacon (a file set copied off a dead fleet
+must not report every host quiet); ``--live`` anchors it to the wall clock
+instead, for watching a running fleet.
+
+Stdlib-only: ``telemetry/fleet.py`` is loaded by file path, so this runs on
+a login node with nothing installed (the same posture as
+``tools/metrics_report.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+from _jsonout import write_json  # noqa: E402
+
+
+def _load_fleet_module():
+    """``telemetry/fleet.py`` by file path — stdlib-only by design, so the
+    package (and jax) never has to be importable here."""
+    path = (Path(__file__).resolve().parent.parent
+            / "neuronx_distributed_training_tpu" / "telemetry" / "fleet.py")
+    spec = importlib.util.spec_from_file_location("_nxdt_fleet", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules[module]:
+    # register BEFORE exec or every @dataclass in the file blows up
+    sys.modules["_nxdt_fleet"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:
+            return "nan"
+        a = abs(v)
+        if a != 0 and (a >= 1e6 or a < 1e-3):
+            return f"{v:.3e}"
+        return f"{v:.4f}" if a < 100 else f"{v:,.1f}"
+    return str(v)
+
+
+def _table(rows: list[tuple], header: tuple) -> str:
+    widths = [max(len(str(r[i])) for r in [header, *rows])
+              for i in range(len(header))]
+
+    def fmt_row(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt_row(header), sep, *(fmt_row(r) for r in rows)])
+
+
+def resolve_input(path: str) -> tuple[str, str | None]:
+    """``(kind, resolved)`` — kind is ``fleet_dir`` / ``summary`` / None."""
+    if os.path.isdir(path):
+        fleet = os.path.join(path, "fleet")
+        if os.path.isdir(fleet):
+            return "fleet_dir", fleet
+        if any(f.startswith("host_") and f.endswith(".jsonl")
+               for f in os.listdir(path)):
+            return "fleet_dir", path
+        summary = os.path.join(path, "fleet_summary.json")
+        if os.path.exists(summary):
+            return "summary", summary
+        return "none", None
+    if os.path.exists(path):
+        return "summary", path
+    return "none", None
+
+
+def render(summary: dict) -> str:
+    parts: list[str] = []
+    n = summary.get("n_hosts", 0)
+    parts.append(f"fleet: {n} host{'s' if n != 1 else ''} "
+                 f"(stale_after={_fmt(summary.get('stale_after_seconds'))}s "
+                 f"— docs/observability.md 'Fleet observability')")
+
+    hosts = summary.get("hosts") or {}
+    if hosts:
+        rows = []
+        quiet = {q["host"] for q in summary.get("quiet_hosts") or []}
+        for hid in sorted(hosts, key=lambda h: int(h)):
+            h = hosts[hid]
+            status = ("QUIET" if int(hid) in quiet
+                      else "died" if h.get("last_exception")
+                      else "closed" if h.get("closed") else "live")
+            rows.append((hid, h.get("last_step"), h.get("beacons"), status,
+                         _fmt(h.get("step_time")), _fmt(h.get("mfu")),
+                         _fmt(h.get("goodput_fraction")),
+                         _fmt(h.get("data_wait_seconds"))))
+        parts.append(_table(rows, ("host", "step", "beacons", "status",
+                                   "step_time", "mfu", "goodput",
+                                   "data_wait_s")))
+
+    st = summary.get("straggler")
+    if st:
+        parts.append(
+            f"straggler: host {st['host']} led {st['windows_led']}/"
+            f"{st['windows_attributed']} attributed windows "
+            f"(of {st['windows_total']}) — dominant cause: {st['cause']}")
+    windows = summary.get("windows") or []
+    if windows:
+        rows = [(w["step"], _fmt(w.get("arrival_skew_seconds")),
+                 w.get("straggler_host") if w.get("straggler_host") is not None
+                 else "-",
+                 w.get("cause") or "balanced",
+                 _fmt(w.get("straggler_excess_seconds")))
+                for w in windows[-10:]]
+        parts.append("recent windows (straggler = busiest host; cause from "
+                     "its own span deltas):")
+        parts.append(_table(rows, ("step", "skew_s", "straggler", "cause",
+                                   "excess_s")))
+
+    spread = summary.get("spread") or {}
+    if spread:
+        rows = []
+        for k in sorted(spread):
+            s = spread[k]
+            rows.append((k,
+                         f"{_fmt(s['min']['value'])} (host {s['min']['host']})",
+                         _fmt(s["p50"]),
+                         f"{_fmt(s['max']['value'])} (host {s['max']['host']})"))
+        parts.append("per-host spread:")
+        parts.append(_table(rows, ("metric", "min", "p50", "max")))
+
+    gp = summary.get("goodput")
+    if gp:
+        parts.append(
+            f"fleet goodput: {_fmt(gp.get('fleet_goodput_fraction'))} "
+            f"(worst: host {gp.get('worst_host')}) = 1 - common overhead "
+            f"{_fmt(gp.get('common_overhead_fraction'))} - straggler loss "
+            f"{_fmt(gp.get('straggler_loss_fraction'))} "
+            f"(best: host {gp.get('best_host')})")
+
+    for f in summary.get("findings") or []:
+        parts.append(f"FINDING [{f.get('kind')}] {f.get('message')}")
+    if not summary.get("findings"):
+        parts.append("no findings (no quiet or dead hosts)")
+    return "\n\n".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (with fleet/), a beacon dir, or a "
+                                 "fleet_summary.json")
+    ap.add_argument("--stale-after", type=float, default=600.0,
+                    help="quiet-host threshold seconds (default 600)")
+    ap.add_argument("--max-windows", type=int, default=64,
+                    help="skew windows retained (default 64)")
+    ap.add_argument("--live", action="store_true",
+                    help="anchor quiet-host detection to the wall clock "
+                         "(watching a RUNNING fleet) instead of the newest "
+                         "beacon (offline analysis)")
+    ap.add_argument("--write", action="store_true",
+                    help="also write/refresh fleet_summary.json next to the "
+                         "beacon dir")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the summary as JSON ('-' = stdout, last "
+                         "line, the shared tools/_jsonout contract)")
+    args = ap.parse_args(argv)
+
+    kind, resolved = resolve_input(args.path)
+    if kind == "none":
+        print(f"fleet_monitor: no fleet beacons or summary at {args.path}",
+              file=sys.stderr)
+        return 2
+    if kind == "summary":
+        with open(resolved) as f:
+            try:
+                summary = json.load(f)
+            except ValueError as e:
+                print(f"fleet_monitor: unreadable {resolved}: {e}",
+                      file=sys.stderr)
+                return 2
+    else:
+        fleet = _load_fleet_module()
+        summary = fleet.aggregate_fleet(
+            resolved, stale_after_seconds=args.stale_after,
+            max_windows=args.max_windows,
+            now=time.time() if args.live else None)
+        if args.write:
+            out = os.path.join(os.path.dirname(resolved.rstrip("/")) or ".",
+                               "fleet_summary.json")
+            # THE atomic writer (serialize-first + temp/fsync/rename) —
+            # fleet.py inlines it stdlib-only exactly so tools can share it
+            fleet.write_fleet_summary(summary, out)
+            print(f"wrote {out}", file=sys.stderr)
+
+    print(render(summary))
+    if args.json:
+        write_json(summary, args.json)
+    return 1 if summary.get("findings") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,6 +7,14 @@ unified telemetry layer wrote, in one screen.
 
     python tools/metrics_report.py nxdt_experiments/hf_llama3_8B/version_0
     python tools/metrics_report.py path/to/metrics.jsonl --last 50
+    python tools/metrics_report.py run_dir --follow --interval 5
+
+``--follow`` live-tails a RUNNING fleet from one terminal: the report
+re-renders every ``--interval`` seconds, picking up new metrics.jsonl
+lines, the latest ``fleet_summary.json`` (straggler / quiet-host findings
+from the beacon plane, docs/observability.md "Fleet observability"), and a
+per-host beacon freshness line tailed straight from ``fleet/host_*.jsonl``.
+Stop with Ctrl-C.
 
 Pure stdlib on purpose: it must run on a login node with nothing installed.
 """
@@ -275,6 +283,116 @@ def perf_contract_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def alerts_section(summary: dict) -> str:
+    """Alert-engine trail (telemetry.alerts -> run_summary.json "alerts"):
+    one line per firing, with the action the loop took."""
+    alerts = summary.get("alerts") or []
+    if not alerts:
+        return ""
+    lines = ["", f"alerts ({len(alerts)} firing"
+                 f"{'s' if len(alerts) != 1 else ''} — "
+                 f"docs/observability.md 'Alert rules')"]
+    for a in alerts:
+        if not isinstance(a, dict):
+            lines.append(f"  (unreadable entry: {a!r})")
+            continue
+        lines.append(f"  step {str(a.get('step', '?')):<8} "
+                     f"action={str(a.get('action', '?')):<5} "
+                     f"[{a.get('rule', '?')}] {a.get('message', '')}")
+    return "\n".join(lines)
+
+
+def fleet_section(run_dir: str | None) -> str:
+    """Fleet plane summary (telemetry.fleet -> fleet_summary.json): host
+    count, the modal straggler with its cause, quiet hosts, and the fleet
+    goodput decomposition — render the full per-window breakdown with
+    ``tools/fleet_monitor.py``."""
+    if not run_dir:
+        return ""
+    path = os.path.join(run_dir, "fleet_summary.json")
+    if not os.path.exists(path):
+        return ""
+    try:
+        with open(path) as f:
+            fs = json.load(f)
+    except ValueError:
+        return f"\nunreadable {path}"
+    lines = ["", f"fleet ({fs.get('n_hosts', 0)} hosts — "
+                 f"tools/fleet_monitor.py renders the full breakdown)"]
+    st = fs.get("straggler")
+    if st:
+        lines.append(f"  straggler             host {st.get('host')} "
+                     f"({st.get('cause')}; led {st.get('windows_led')}/"
+                     f"{st.get('windows_attributed')} windows)")
+    gp = fs.get("goodput") or {}
+    if gp.get("fleet_goodput_fraction") is not None:
+        lines.append(f"  fleet_goodput         "
+                     f"{_fmt(gp['fleet_goodput_fraction'])} "
+                     f"(straggler loss {_fmt(gp.get('straggler_loss_fraction', 0))}, "
+                     f"common {_fmt(gp.get('common_overhead_fraction', 0))})")
+    for q in fs.get("quiet_hosts") or []:
+        lines.append(f"  QUIET host {q.get('host')}    last step "
+                     f"{q.get('last_step')}, silent "
+                     f"{_fmt(q.get('silent_seconds'))} s")
+    for f in fs.get("findings") or []:
+        if f.get("kind") != "fleet_stall":  # quiet hosts rendered above
+            lines.append(f"  [{f.get('kind')}] {f.get('message')}")
+    return "\n".join(lines)
+
+
+def beacon_tail_section(run_dir: str | None) -> str:
+    """Per-host beacon freshness tailed straight from ``fleet/host_*.jsonl``
+    (no aggregation — just "who reported what, when", cheap enough for the
+    --follow refresh loop).  Torn tail lines (a live writer mid-flush, a
+    died host) are skipped."""
+    if not run_dir:
+        return ""
+    fleet_dir = os.path.join(run_dir, "fleet")
+    if not os.path.isdir(fleet_dir):
+        return ""
+    import glob
+    import time as _time
+
+    rows = []
+    now = _time.time()
+    for path in sorted(glob.glob(os.path.join(fleet_dir, "host_*.jsonl"))):
+        last = None
+        try:
+            # only the last record matters: seek to the final few KB
+            # instead of re-parsing a multi-day stream on every refresh
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 8192))
+                tail = f.read().decode("utf-8", errors="replace")
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue  # the cut-off first line / a torn tail
+        except OSError:
+            continue
+        if not isinstance(last, dict):
+            continue
+        age = (now - float(last["t_wall"])
+               if last.get("t_wall") is not None else None)
+        status = ("closed" if last.get("closing")
+                  else "DIED" if last.get("last_exception") else "live")
+        m = last.get("metrics") or {}
+        rows.append((os.path.basename(path).split(".")[0],
+                     str(last.get("step", "?")), status,
+                     f"{age:.0f}s" if age is not None else "-",
+                     _fmt(m["loss"]) if m.get("loss") is not None else "-"))
+    if not rows:
+        return ""
+    return "\n".join(["", "beacons (age = seconds since last heartbeat)",
+                      _table(rows, ("host", "step", "status", "age",
+                                    "loss"))])
+
+
 def census_section(summary: dict) -> str:
     lines: list[str] = []
     if "compile_seconds" in summary:
@@ -307,7 +425,8 @@ def census_section(summary: dict) -> str:
 
 
 def render(metrics_path: str | None, summary_path: str | None,
-           last_n: int = 0, trace_path: str | None = None) -> str:
+           last_n: int = 0, trace_path: str | None = None,
+           run_dir: str | None = None) -> str:
     parts: list[str] = []
     if metrics_path and os.path.exists(metrics_path):
         records = load_metrics(metrics_path)
@@ -327,9 +446,12 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(elastic_section(summary))
         parts.append(integrity_section(summary))
         parts.append(anomalies_section(summary))
+        parts.append(alerts_section(summary))
         parts.append(census_section(summary))
         parts.append(provenance_section(summary))
         parts.append(perf_contract_section(summary))
+    parts.append(fleet_section(run_dir))
+    parts.append(beacon_tail_section(run_dir))
     if trace_path and os.path.exists(trace_path):
         try:
             with open(trace_path) as f:
@@ -345,25 +467,60 @@ def main(argv: list[str] | None = None) -> int:
                                  "run_summary.json) or a metrics.jsonl file")
     ap.add_argument("--last", type=int, default=0,
                     help="only the last N boundary records (default: all)")
+    ap.add_argument("--follow", action="store_true",
+                    help="live-tail: re-render every --interval seconds "
+                         "(metrics.jsonl + fleet beacons; Ctrl-C stops)")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="refresh interval seconds for --follow (default 5)")
+    ap.add_argument("--refreshes", type=int, default=0,
+                    help="stop --follow after N refreshes (0 = forever; "
+                         "mainly for smoke tests)")
     args = ap.parse_args(argv)
 
     path = args.path
     if os.path.isdir(path):
         metrics_path = os.path.join(path, "metrics.jsonl")
         summary_path = os.path.join(path, "run_summary.json")
+        run_dir = path
     elif path.endswith(".jsonl"):
         metrics_path = path
         summary_path = os.path.join(os.path.dirname(path), "run_summary.json")
+        run_dir = os.path.dirname(path) or "."
     else:
         metrics_path, summary_path = None, path
+        run_dir = os.path.dirname(path) or "."
     trace_path = (os.path.join(os.path.dirname(summary_path),
                                "trace_summary.json")
                   if summary_path else None)
     if not any(p and os.path.exists(p) for p in (metrics_path, summary_path)):
         print(f"metrics_report: nothing to read at {path}", file=sys.stderr)
         return 2
-    print(render(metrics_path, summary_path, args.last, trace_path))
-    return 0
+    if not args.follow:
+        print(render(metrics_path, summary_path, args.last, trace_path,
+                     run_dir))
+        return 0
+
+    # --follow: the one-terminal fleet watch.  Re-render from scratch each
+    # refresh (the files are small; incremental tailing lives in the
+    # aggregator, not the report) with a timestamped banner per frame so
+    # scrollback stays legible without cursor tricks.
+    import time as _time
+
+    n = 0
+    try:
+        while True:
+            n += 1
+            stamp = _time.strftime("%H:%M:%S")
+            print(f"\n===== metrics_report --follow  refresh {n} "
+                  f"({stamp}; every {args.interval:g}s, Ctrl-C stops) =====")
+            print(render(metrics_path, summary_path,
+                         args.last or 20, trace_path, run_dir))
+            sys.stdout.flush()
+            if args.refreshes and n >= args.refreshes:
+                return 0
+            _time.sleep(max(args.interval, 0.0))
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":
