@@ -15,11 +15,13 @@ reference, by design:
 
 from __future__ import annotations
 
+import errno
+import logging
 import os
 import queue
 import threading
 import time
-from typing import Any, Iterator, Optional, Sequence
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
@@ -28,6 +30,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from neuronx_distributed_training_tpu.data.packing import IGNORE_INDEX
 from neuronx_distributed_training_tpu.data.sampler import PretrainingSampler, RandomSampler
 from neuronx_distributed_training_tpu.parallel.mesh import DATA_AXES
+
+
+logger = logging.getLogger(__name__)
+
+#: errno values treated as TRANSIENT data-READ failures (an NFS/FUSE mount
+#: flap, a stale handle, an object-store hiccup, a wedged-but-recovering
+#: arrow page-in) — worth a bounded retry with backoff on the prefetch
+#: thread.  Anything else (missing file, bad index, programming error)
+#: re-raises immediately.  The WRITE-side sibling table lives in
+#: ``checkpoint.manager.TRANSIENT_SAVE_ERRNOS``.
+TRANSIENT_READ_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT, errno.EINTR,
+    errno.ESTALE, errno.ENETDOWN, errno.ENETUNREACH, errno.ECONNRESET,
+})
+
+
+def is_transient_io_error(exc: BaseException) -> bool:
+    """Is ``exc`` (or anything in its cause/context chain) a transient read
+    I/O error worth retrying?  Dataset libraries (arrow, fsspec, datasets)
+    wrap the underlying ``OSError``, so the chain is walked — the same
+    classifier shape as ``checkpoint.manager.is_transient_save_error``."""
+    seen: set[int] = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, TimeoutError):
+            return True
+        if isinstance(cur, OSError) and cur.errno in TRANSIENT_READ_ERRNOS:
+            return True
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 class DataStallError(RuntimeError):
@@ -54,16 +87,25 @@ class PrefetchIterator:
     curated diagnosis instead of freezing the run silently.  The timeout is
     per-batch wait, not cumulative — a healthy-but-slow source that keeps
     producing within the bound never trips it.
+
+    ``activity_fn`` (e.g. ``DataModule.last_io_activity``) is the retry
+    handshake: while the producer side is actively RETRYING a transient
+    read error (bounded exponential backoff on the prefetch thread —
+    ``DataModule._fetch_with_retry``), the stall timer defers, so
+    :class:`DataStallError` fires only after the retries are exhausted or
+    the source is genuinely silent — never mid-recovery.
     """
 
     _DONE = object()
 
     def __init__(self, it: Iterator, depth: int = 2,
-                 timeout_seconds: Optional[float] = None):
+                 timeout_seconds: Optional[float] = None,
+                 activity_fn: Optional[Callable[[], float]] = None):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._timeout = (float(timeout_seconds)
                          if timeout_seconds and timeout_seconds > 0 else None)
+        self._activity = activity_fn
         # the thread target captures ONLY the queue/event/sentinel — never
         # self — so an abandoned iterator stays collectible: __del__ then
         # fires, stops the thread, and the queued device batches are freed
@@ -111,6 +153,17 @@ class PrefetchIterator:
                     raise StopIteration
                 if (waited_from is not None
                         and time.monotonic() - waited_from > self._timeout):
+                    if self._activity is not None:
+                        try:
+                            act = float(self._activity() or 0.0)
+                        except Exception:  # noqa: BLE001 — a seam, not load-bearing
+                            act = 0.0
+                        if act and time.monotonic() - act <= self._timeout:
+                            # the producer is mid-retry (transient I/O
+                            # backoff): defer the stall verdict until the
+                            # retries themselves go silent
+                            waited_from = time.monotonic()
+                            continue
                     state = ("still running — the source itself is hung "
                              "(dead mount? wedged arrow page-in? remote "
                              "store stall?)" if self._thread.is_alive()
@@ -297,6 +350,8 @@ class DataModule:
         consumed_samples: int = 0,
         input_names: Sequence[str] = ("input_ids", "labels", "loss_mask"),
         pad_id: Optional[int] = None,
+        io_retries: int = 3,
+        io_retry_backoff_seconds: float = 0.5,
     ):
         self.global_batch_size = global_batch_size
         self.input_names = tuple(input_names)
@@ -305,6 +360,15 @@ class DataModule:
         # attaches a BatchStats accumulator here; global_batches feeds it
         # on the prefetch thread and the boundary drains it into metrics
         self.batch_stats: Optional[BatchStats] = None
+        # transient-read retry policy (``data.io_retries`` /
+        # ``data.io_retry_backoff_seconds``; the trainer imposes the config
+        # values post-construction).  ``io_retry_count`` is the cumulative
+        # counter the boundary surfaces as the ``data/io_retries`` metric.
+        self.io_retries = int(io_retries)
+        self.io_retry_backoff_seconds = float(io_retry_backoff_seconds)
+        self.io_retry_count = 0
+        self._io_lock = threading.Lock()
+        self._io_activity = 0.0
         if shuffle:
             self.sampler: Any = RandomSampler(
                 total_samples, global_batch_size, seed=seed, consumed_samples=consumed_samples
@@ -322,11 +386,54 @@ class DataModule:
     def fetch_rows(self, idx: np.ndarray) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
+    def last_io_activity(self) -> float:
+        """Monotonic timestamp of the last transient-retry attempt — the
+        data-stall watchdog's handshake (``PrefetchIterator(activity_fn=``):
+        a stall verdict is deferred while retries are still in flight."""
+        return self._io_activity
+
+    def _fetch_with_retry(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        """``fetch_rows`` with bounded exponential-backoff retry on
+        transient read errors (:func:`is_transient_io_error` — the
+        cause-chain classifier).  Runs on the PREFETCH thread, so neither
+        the backoff sleeps nor a recovered page-in ever lands between
+        dispatches.  Non-transient errors and exhausted retries re-raise;
+        only then can the consumer see a failure."""
+        delay = self.io_retry_backoff_seconds
+        for attempt in range(self.io_retries + 1):
+            try:
+                return self.fetch_rows(idx)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt >= self.io_retries or not is_transient_io_error(e):
+                    raise
+                with self._io_lock:
+                    self.io_retry_count += 1
+                logger.warning(
+                    "data: transient read error (%s: %s) — retry %d/%d in "
+                    "%.1fs", type(e).__name__, e, attempt + 1,
+                    self.io_retries, delay)
+                # sleep in short slices, refreshing the activity timestamp
+                # each one: a backoff delay LONGER than the stall timeout
+                # must still defer the stall verdict — the contract is
+                # "DataStallError only after retries are exhausted", not
+                # "unless the backoff outgrew the timeout"
+                deadline = time.monotonic() + delay
+                while True:
+                    self._io_activity = time.monotonic()
+                    remaining = deadline - self._io_activity
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(remaining, 0.25))
+                delay *= 2
+                self._io_activity = time.monotonic()
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def global_batches(self) -> Iterator[dict[str, np.ndarray]]:
         """Yield processed host-side global batches (numpy)."""
         for idx in self.sampler:
             batch = process_global_batch(
-                self.fetch_rows(idx), input_names=self.input_names, pad_id=self.pad_id
+                self._fetch_with_retry(idx), input_names=self.input_names,
+                pad_id=self.pad_id
             )
             if self.batch_stats is not None:
                 self.batch_stats.update(batch)

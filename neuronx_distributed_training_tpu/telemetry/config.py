@@ -53,6 +53,7 @@ from neuronx_distributed_training_tpu.telemetry.alerts import (
 from neuronx_distributed_training_tpu.telemetry.fleet import FleetConfig
 from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
 from neuronx_distributed_training_tpu.telemetry.trace import TraceConfig
+from neuronx_distributed_training_tpu.trainer.control import ControlConfig
 
 #: boolean knob name -> default; the single source of truth for schema
 #: validation (the nested ``health``/``trace``/``fleet``/``alerts`` blocks
@@ -77,7 +78,7 @@ TELEMETRY_KNOBS: dict[str, bool] = {
 }
 
 #: nested (non-boolean) telemetry blocks, each validated by its own parser
-_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts")
+_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts", "control")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +94,9 @@ class TelemetryConfig:
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     alerts: tuple[AlertRule, ...] = ()
+    # coordinated fleet control (trainer.control): consensus stop decisions
+    # via the boundary control word + the operator command channel
+    control: ControlConfig = dataclasses.field(default_factory=ControlConfig)
 
     @classmethod
     def from_config(cls, block: Any) -> "TelemetryConfig":
@@ -142,12 +146,30 @@ class TelemetryConfig:
             if k == "alerts":
                 values[k] = parse_alerts(v)
                 continue
+            if k == "control":
+                values[k] = ControlConfig.from_config(v)
+                continue
             if not isinstance(v, bool):
                 raise ValueError(
                     f"exp_manager.telemetry.{k} must be a boolean, got {v!r}"
                 )
             values[k] = v
-        return cls(**values)
+        out = cls(**values)
+        # cross-block rule: the hang watchdog dumps through a bundle-capable
+        # monitor, which any of health / fleet / a dump-action alert rule /
+        # the fleet control plane arms — with NONE of them on, a positive
+        # timeout would silently never arm
+        if out.health.watchdog_timeout_seconds > 0 and not (
+                out.health.enabled or out.fleet.enabled
+                or out.control.enabled
+                or any(r.action == "dump" for r in out.alerts)):
+            raise ValueError(
+                "exp_manager.telemetry.health.watchdog_timeout_seconds > 0 "
+                "needs a bundle-capable monitor: enable telemetry.health, "
+                "telemetry.fleet, telemetry.control, or a dump-action alert "
+                "rule — it would otherwise silently never arm"
+            )
+        return out
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

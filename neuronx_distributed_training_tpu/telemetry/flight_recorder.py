@@ -28,6 +28,13 @@ The host-side half of the health subsystem (in-graph probes live in
   device-safe bundle (host-side ring metadata only — fetching device arrays
   from a hung backend would hang the watchdog too) and optionally aborts the
   process so the scheduler can restart it from the last good checkpoint.
+  Under the fleet control plane (``trainer.control``,
+  docs/observability.md "Fleet control") the watchdog instead **escapes**:
+  after the bundle it runs the registered teardown hooks (the final dying
+  fleet beacon, the control-trail exit note) and ``os._exit``\\ s with the
+  tagged ``EXIT_HANG_ESCAPE`` code — a dead peer mid-collective must never
+  leave the survivors hanging forever; the orchestrator restarts the
+  incarnation and elastic resume + integrity walk-back do the recovery.
 """
 
 from __future__ import annotations
@@ -356,6 +363,23 @@ class HangWatchdog:
         self.monitor = monitor
         self.abort = abort
         self.fired = False
+        # hang-escape (trainer.control): when armed, a fire EXITS the
+        # process with this tagged code after running the teardown hooks —
+        # survivors of a dead peer never hang forever.  `_exit_fn` is the
+        # test seam (tests record the code instead of dying).
+        self.escape_code: Optional[int] = None
+        self._escape_hooks: list = []
+        self._exit_fn = os._exit
+
+    def arm_escape(self, exit_code: int, *hooks) -> None:
+        """Arm the collective-hang escape: on fire, after the forensic
+        bundle, run ``hooks`` (best-effort — e.g. the final dying fleet
+        beacon and the control-trail exit note; a hook must never touch the
+        hung device) and ``os._exit(exit_code)``.  ``os._exit`` on purpose:
+        ``finally`` blocks and atexit handlers would block on the very
+        backend that is hung."""
+        self.escape_code = int(exit_code)
+        self._escape_hooks = list(hooks)
 
     def guard(self, what: str, step: int):
         return _WatchdogGuard(self, what, int(step))
@@ -371,10 +395,21 @@ class HangWatchdog:
             "health watchdog: %r did not complete within %.0fs at step %d — "
             "%s%s", what, self.timeout_seconds, step,
             "dumping stacks" if first else "already dumped once; not re-dumping",
-            " and aborting" if self.abort else "",
+            " and exiting with the hang-escape code"
+            if self.escape_code is not None
+            else " and aborting" if self.abort else "",
         )
         if self.monitor is not None and first:
             self.monitor.dump_hang(step, what, _all_thread_stacks())
+        if self.escape_code is not None:
+            if first:
+                for hook in self._escape_hooks:
+                    try:
+                        hook(what, step)
+                    except Exception as e:  # noqa: BLE001 — escape must win
+                        logger.warning("hang-escape hook failed: %s", e)
+            self._exit_fn(self.escape_code)
+            return  # only reached when _exit_fn is a test seam
         if self.abort:
             import signal
 

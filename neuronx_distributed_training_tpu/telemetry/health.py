@@ -149,12 +149,11 @@ class HealthConfig:
                 f"be >= 0 (0 disables the watchdog), got "
                 f"{out.watchdog_timeout_seconds}"
             )
-        if out.watchdog_timeout_seconds > 0 and not out.enabled:
-            raise ValueError(
-                "exp_manager.telemetry.health.watchdog_timeout_seconds > 0 "
-                "requires health.enabled: true (the watchdog dumps through "
-                "the flight recorder) — it would otherwise silently never arm"
-            )
+        # NOTE the watchdog needs a bundle-capable monitor to dump through,
+        # but health.enabled is no longer the only thing that arms one: the
+        # fleet plane, dump-action alert rules, and the fleet control plane
+        # all arm a bundle-only monitor.  The cross-block check therefore
+        # lives in TelemetryConfig.from_config, which sees every block.
         if out.data_wait_timeout_seconds < 0:
             raise ValueError(
                 f"exp_manager.telemetry.health.data_wait_timeout_seconds "

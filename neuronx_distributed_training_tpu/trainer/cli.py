@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import sys
 
 logger = logging.getLogger("nxdt.train")
 
@@ -162,6 +163,12 @@ def main() -> None:
     # anything materializes.  Runs before --autotune: a replan IS the plan
     # for this incarnation.
     replan = None
+    from neuronx_distributed_training_tpu.trainer.control import (
+        EXIT_ALL_CORRUPT,
+        EXIT_DATA_STALL,
+        EXIT_ELASTIC_REFUSED,
+        exit_code_for_stop,
+    )
     from neuronx_distributed_training_tpu.trainer.elastic import (
         ElasticConfig,
         ElasticResumeError,
@@ -179,13 +186,17 @@ def main() -> None:
             replan = maybe_replan(cfg, len(jax.devices()), elastic=elastic_cfg)
         except ElasticResumeError as e:
             # curated operator-facing refusal (the message carries the --set
-            # remediation) — a clean one-line exit, not a traceback
-            raise SystemExit(f"elastic resume refused: {e}") from e
+            # remediation) — a clean one-line exit with the tagged code
+            # (trainer.control exit-code table), not a traceback
+            print(f"elastic resume refused: {e}", file=sys.stderr)
+            raise SystemExit(EXIT_ELASTIC_REFUSED) from e
         except CheckpointIntegrityError as e:
             # every retained checkpoint failed verification at discovery —
             # the message names each step's verdict (docs/elasticity.md
-            # "Integrity & walk-back")
-            raise SystemExit(f"elastic resume refused: {e}") from e
+            # "Integrity & walk-back"); the tagged code tells the
+            # orchestrator to PAGE, not blind-restart
+            print(f"elastic resume refused: {e}", file=sys.stderr)
+            raise SystemExit(EXIT_ALL_CORRUPT) from e
         if replan.replanned:
             cfg = replan.cfg
             logger.warning(
@@ -292,8 +303,26 @@ def main() -> None:
                     cost.get("flops"), cost.get("bytes accessed"))
         return
 
-    metrics = trainer.fit()
+    from neuronx_distributed_training_tpu.data import DataStallError
+
+    try:
+        metrics = trainer.fit()
+    except DataStallError as e:
+        # the data-stall watchdog already dumped its bundle; exit with the
+        # tagged code so the orchestrator pages instead of blind-restarting
+        # into the same dead mount
+        print(f"data stall: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_DATA_STALL) from e
     logger.info("done: %s", {k: round(v, 4) for k, v in metrics.items()})
+    # failure-class exit codes (trainer.control, docs/observability.md
+    # "Fleet control"): a health/alert halt exits tagged so restart-vs-page
+    # policy needs nothing but the code; graceful stops (preemption,
+    # operator stop, max_time) exit 0 — resume_if_exists continues the run
+    code = exit_code_for_stop(getattr(trainer, "stop_class", None))
+    if code:
+        logger.warning("exiting with tagged code %d (%s)", code,
+                       trainer.stop_class)
+        raise SystemExit(code)
 
 
 if __name__ == "__main__":

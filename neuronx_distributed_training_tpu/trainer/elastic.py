@@ -535,7 +535,7 @@ class SimulatedPreemption(RuntimeError):
 
 @dataclasses.dataclass
 class FaultInjector:
-    """Kills (or gracefully preempts) a run at a configurable point.
+    """Kills (or gracefully preempts, or hangs) a run at a configurable point.
 
     Attach to a trainer (``trainer.fault_injector = FaultInjector(...)``);
     the fit loop and checkpoint paths call :meth:`maybe_fire` at their
@@ -546,25 +546,38 @@ class FaultInjector:
       async save is in flight when the fault hits — the drain-on-teardown
       contract is what keeps it from being orphaned);
     - ``phase="restore"`` mid-restore, after the checkpoint was read but
-      before any state was applied (the save must survive untouched).
+      before any state was applied (the save must survive untouched);
+    - ``phase="sync"``    inside the boundary's hang-watchdog guard, just
+      before the blocking metric fetch (the collective rendezvous point).
 
     ``mode="kill"`` raises :class:`SimulatedPreemption`; ``mode="sigterm"``
     returns True once so the caller requests the graceful-stop path (the
-    grace-window emergency checkpoint).
+    grace-window emergency checkpoint); ``mode="hang"`` BLOCKS for
+    ``hang_seconds`` — the stand-in for a dead peer mid-collective, whose
+    boundary sync never returns.  A hung injection point is what the
+    armed :class:`~neuronx_distributed_training_tpu.telemetry.
+    flight_recorder.HangWatchdog` escape is drilled against: the watchdog
+    must dump the ``hang_<step>/`` bundle, emit the dying beacon, and exit
+    the process with ``EXIT_HANG_ESCAPE`` long before the sleep ends.
     """
 
     at_step: int
-    mode: str = "kill"          # kill | sigterm
-    phase: str = "step"         # step | save | restore
+    mode: str = "kill"          # kill | sigterm | hang
+    phase: str = "step"         # step | save | restore | sync
     fired: bool = False
+    #: how long mode="hang" blocks; the watchdog is expected to escape the
+    #: process well before this elapses (bounded so a BROKEN watchdog fails
+    #: the drill in minutes, not forever)
+    hang_seconds: float = 60.0
 
     def __post_init__(self) -> None:
-        if self.mode not in ("kill", "sigterm"):
-            raise ValueError(f"FaultInjector.mode must be kill|sigterm, "
+        if self.mode not in ("kill", "sigterm", "hang"):
+            raise ValueError(f"FaultInjector.mode must be kill|sigterm|hang, "
                              f"got {self.mode!r}")
-        if self.phase not in ("step", "save", "restore"):
-            raise ValueError(f"FaultInjector.phase must be step|save|restore, "
-                             f"got {self.phase!r}")
+        if self.phase not in ("step", "save", "restore", "sync"):
+            raise ValueError(
+                f"FaultInjector.phase must be step|save|restore|sync, "
+                f"got {self.phase!r}")
 
     def maybe_fire(self, phase: str, step: int) -> bool:
         """Called at each injection point; fires at most once."""
@@ -574,4 +587,10 @@ class FaultInjector:
         if self.mode == "kill":
             raise SimulatedPreemption(
                 f"injected {self.phase} kill at step {step}")
+        if self.mode == "hang":
+            logger.warning("injected %s hang at step %d (%.0fs — the "
+                           "watchdog should escape first)", self.phase, step,
+                           self.hang_seconds)
+            time.sleep(self.hang_seconds)
+            return False
         return True

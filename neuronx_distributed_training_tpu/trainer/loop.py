@@ -258,6 +258,16 @@ class Trainer:
     # fit loop's scope, so those call sites park the notice here and the loop
     # top converts it into a graceful-stop request (same path as SIGTERM)
     preemption_notice: Optional[str] = None
+    # drill/test seam of the fleet control plane (trainer.control): extra
+    # control-word bits standing in for other hosts' contributions on a
+    # single-process mesh; the production path folds real processes through
+    # the boundary collective
+    control_peer_words: Optional[Callable[[], int]] = None
+    # the deciding stop condition of the finished run ("health_halt",
+    # "alert_halt", "data_stall", "preemption", "operator_stop",
+    # "max_time"; None for a clean completion) — trainer.control's
+    # exit_code_for_stop maps it to the orchestrator-facing exit code
+    stop_class: Optional[str] = None
 
     # -- assembly -----------------------------------------------------------
 
@@ -813,6 +823,16 @@ class Trainer:
                 seed=seed,
             )
 
+        # transient-read retry knobs (``data.io_retries`` /
+        # ``data.io_retry_backoff_seconds``) imposed on whatever module the
+        # build produced — attributes, not ctor args, so custom test doubles
+        # keep working (without the attributes they simply don't retry)
+        data_block = dict(cfg.get("data", {}) or {})
+        for key, cast in (("io_retries", int),
+                          ("io_retry_backoff_seconds", float)):
+            if key in data_block and hasattr(data_module, key):
+                setattr(data_module, key, cast(data_block[key]))
+
         exp = ExpManager.from_config(cfg, global_batch_size=sched["global_batch_size"])
 
         # -- telemetry wiring: MFU reference + the static run facts the
@@ -1161,12 +1181,9 @@ class Trainer:
             )
             if hc.enabled else None
         )
-        watchdog = (
-            HangWatchdog(hc.watchdog_timeout_seconds, monitor,
-                         abort=hc.watchdog_abort)
-            if monitor is not None and hc.watchdog_timeout_seconds > 0
-            else None
-        )
+        # (the hang watchdog is built AFTER the fleet/alert/control blocks
+        # below: a bundle-only monitor armed there must reach it, and the
+        # control plane decides whether a fire escapes the process)
         # -- fleet observability plane + declarative alerts (telemetry.fleet
         # / telemetry.alerts — docs/observability.md "Fleet observability"):
         # this host appends a beacon to fleet/host_<id>.jsonl at every
@@ -1196,28 +1213,45 @@ class Trainer:
 
             alerts = AlertEngine(
                 tel.alerts, write_run_summary=self.exp.write_run_summary)
-            halt_rules = [r.name for r in tel.alerts if r.action == "halt"]
-            if halt_rules and jax.process_count() > 1:
-                # the stop decision is HOST-LOCAL: a halt rule on a
-                # host-local metric (spans, timing-derived throughput/mfu
-                # at the margin, or fleet/* which only rank 0 computes)
-                # can fire on one host while the rest keep dispatching
-                # toward a collective rendezvous that host will never
-                # join.  Only device-computed replicated metrics (loss,
-                # grad_norm, health/* — identical on every host) halt
-                # consistently everywhere.
-                logger.warning(
-                    "alert rules %s use action=halt in a multi-host run: "
-                    "halt is evaluated PER HOST — on a metric that is not "
-                    "bit-identical across hosts (spans, fleet/*, "
-                    "timing-derived mfu/throughput at the threshold "
-                    "margin), one host may stop alone and stall the fleet "
-                    "at the next collective; prefer replicated metrics "
-                    "(loss, grad_norm, health/*) for halt, and log/dump "
-                    "for host-local ones (docs/observability.md "
-                    "'Alert rules')", halt_rules)
+        # -- coordinated fleet control (trainer.control — docs/observability
+        # .md "Fleet control"): every stop/checkpoint decision folds through
+        # ONE tiny replicated collective at the deterministic boundary
+        # cadence, so all hosts derive the SAME decision at the same step.
+        # An alert halt, a health halt, a SIGTERM notice, or an operator
+        # command on ONE host stops the whole fleet with a drained
+        # emergency save instead of stalling the survivors at the next
+        # collective rendezvous.
+        ccfg = tel.control
+        control = None
+        if ccfg.enabled:
+            try:
+                from neuronx_distributed_training_tpu.trainer.control import (
+                    ControlPlane,
+                )
+
+                chost = int(jax.process_index())
+                control = ControlPlane(
+                    ccfg, self.exp.log_dir, host=chost,
+                    poll_commands=ccfg.poll_commands and chost == 0,
+                    write_run_summary=self.exp.write_run_summary,
+                    peer_words=self.control_peer_words,
+                )
+            except Exception as e:  # noqa: BLE001 — never kill the launch
+                logger.warning("fleet control plane unavailable: %s", e)
+        elif jax.process_count() > 1 and any(
+                r.action == "halt" for r in tel.alerts):
+            # without the control plane a halt decision is host-local: on a
+            # metric that is not bit-identical across hosts, one host can
+            # stop alone and stall the fleet at the next collective — the
+            # consensus control word is the fix
+            logger.warning(
+                "multi-host run with action=halt alert rules and "
+                "exp_manager.telemetry.control disabled: halt decisions "
+                "are host-local; enable the control plane so stops are "
+                "fleet-consistent (docs/observability.md 'Fleet control')")
         if monitor is None and (
                 fleet is not None
+                or control is not None
                 or any(r.action == "dump" for r in tel.alerts)):
             # alert `action: dump` and the fleet's quiet-host findings both
             # reuse the flight recorder's bundle machinery; without the
@@ -1229,6 +1263,40 @@ class Trainer:
                 write_run_summary=self.exp.write_run_summary,
                 rng_seed=STEP_KEY_SEED,
             )
+        watchdog = (
+            HangWatchdog(hc.watchdog_timeout_seconds, monitor,
+                         abort=hc.watchdog_abort)
+            if monitor is not None and hc.watchdog_timeout_seconds > 0
+            else None
+        )
+        if watchdog is not None and control is not None and ccfg.hang_escape:
+            # collective-hang escape (docs/observability.md "Fleet
+            # control"): a boundary sync that exceeds the watchdog timeout
+            # means a peer died mid-collective — after the hang_<step>/
+            # bundle the survivor writes its final DYING beacon and the
+            # control-trail exit note, then exits with the tagged
+            # EXIT_HANG_ESCAPE code.  Survivors never hang forever; the
+            # orchestrator restarts the incarnation and elastic resume +
+            # integrity walk-back do the recovery.
+            from neuronx_distributed_training_tpu.trainer.control import (
+                EXIT_HANG_ESCAPE,
+            )
+
+            def _escape_note(what, step):
+                control.note_exit(
+                    "hang_escape",
+                    f"boundary sync {what!r} exceeded "
+                    f"{hc.watchdog_timeout_seconds:.0f}s at step {step}; "
+                    f"exiting EXIT_HANG_ESCAPE")
+
+            def _escape_beacon(what, step):
+                if fleet is not None:
+                    fleet.close(RuntimeError(
+                        f"hang escape: {what} exceeded "
+                        f"{hc.watchdog_timeout_seconds:.0f}s"), step=step)
+
+            watchdog.arm_escape(EXIT_HANG_ESCAPE, _escape_note,
+                                _escape_beacon)
         halted = False
 
         def _sync_guard(what):
@@ -1259,14 +1327,20 @@ class Trainer:
         el = self.elastic if self.elastic is not None else ElasticConfig()
         stop_requested: dict[str, Any] = {"reason": None, "deadline": None}
 
-        def _request_stop(reason: str) -> None:
+        def _request_stop(reason: str, condition: Optional[str] = None) -> None:
+            # the grace deadline starts at the NOTICE (docs/elasticity.md);
+            # `condition` additionally registers the control-word bit so the
+            # next boundary fold shares the stop with the whole fleet —
+            # without it (control disabled), the stop stays host-local
             stop_requested["reason"] = reason
             if stop_requested["deadline"] is None and el.grace_period_seconds > 0:
                 stop_requested["deadline"] = (
                     _time.monotonic() + el.grace_period_seconds)
+            if control is not None and condition is not None:
+                control.request(condition, reason)
 
         def _on_sigterm(signum, frame):
-            _request_stop("SIGTERM (preemption)")
+            _request_stop("SIGTERM (preemption)", condition="preemption")
 
         old_handler = None
         try:
@@ -1338,7 +1412,9 @@ class Trainer:
             # must be restored before the first fetch.
             batches = PrefetchIterator(
                 self.data_module.sharded_batches(self.mesh),
-                timeout_seconds=hc.data_wait_timeout_seconds)
+                timeout_seconds=hc.data_wait_timeout_seconds,
+                activity_fn=getattr(self.data_module, "last_io_activity",
+                                    None))
             log_every = max(1, int(self.exp.log_every_n_steps))
             census_pending = tel.compile_census
             with self.mesh, shd.use_mesh(self.mesh):
@@ -1358,7 +1434,8 @@ class Trainer:
                         # a sigterm-mode injection fired at the save/restore
                         # point (outside this loop's scope): honor it like a
                         # SIGTERM that landed there
-                        _request_stop(self.preemption_notice)
+                        _request_stop(self.preemption_notice,
+                                      condition="preemption")
                         self.preemption_notice = None
                     if self.fault_injector is not None and \
                             self.fault_injector.maybe_fire("step", self.step):
@@ -1366,16 +1443,22 @@ class Trainer:
                         # step still runs, then the boundary takes the
                         # grace-window emergency checkpoint (kill mode raised
                         # out of maybe_fire instead)
-                        _request_stop("injected preemption notice")
+                        _request_stop("injected preemption notice",
+                                      condition="preemption")
                     with spans.span("data_wait"):
                         try:
                             batch = next(batches)
-                        except DataStallError:
+                        except DataStallError as stall:
                             # data-stall watchdog (telemetry.health.
                             # data_wait_timeout_seconds): feed the existing
                             # hang-watchdog bundle path — thread stacks + a
                             # device-safe forensic bundle — then let the
-                            # curated error propagate instead of freezing
+                            # curated error propagate instead of freezing.
+                            # The transient-I/O retries already ran (and
+                            # deferred this verdict) on the prefetch thread.
+                            self.stop_class = "data_stall"
+                            if control is not None:
+                                control.note_exit("data_stall", str(stall))
                             if monitor is not None:
                                 from neuronx_distributed_training_tpu.telemetry.flight_recorder import (  # noqa: E501
                                     _all_thread_stacks,
@@ -1430,16 +1513,32 @@ class Trainer:
                     self.step += 1
                     if max_time is not None and stop_requested["reason"] is None:
                         if _time.monotonic() - t_start > max_time:
-                            stop_requested["reason"] = f"max_time {cfg_t.get('max_time')}"
+                            if control is not None:
+                                # host clocks disagree at the margin: fold
+                                # the budget stop through the control word
+                                # so the fleet stops at the same step
+                                control.request(
+                                    "max_time",
+                                    f"max_time {cfg_t.get('max_time')}")
+                            else:
+                                stop_requested["reason"] = (
+                                    f"max_time {cfg_t.get('max_time')}")
                     # host sync ONLY at logging/validation/checkpoint
                     # boundaries: between them the loop keeps dispatching
                     # ahead of the device (the reference batches metric
                     # fetches the same way via xm.add_step_closure,
-                    # base.py:235-250)
+                    # base.py:235-250).  Under the control plane a stop
+                    # NOTICE never makes its own boundary: the decision must
+                    # land at a step every host computes identically, or the
+                    # fold collective itself would rendezvous-mismatch — the
+                    # notice waits for the next deterministic boundary (and
+                    # on a real fleet the host keeps dispatching steps until
+                    # then, staying inside every collective).
                     boundary = (
                         self.step % log_every == 0
                         or self.step == self.max_steps
-                        or stop_requested["reason"] is not None
+                        or (control is None
+                            and stop_requested["reason"] is not None)
                         or (val_interval and self.step % val_interval == 0)
                         or (ck_every and self.step % ck_every == 0)
                     )
@@ -1450,6 +1549,12 @@ class Trainer:
                     # the boundary metric fetch is the loop's ONE host sync:
                     # any device time the host outran is absorbed here
                     with spans.span("host_sync"), _sync_guard("host_sync"):
+                        if self.fault_injector is not None:
+                            # drill injection point "sync": a dead peer mid-
+                            # collective — the blocking fetch never returns
+                            # and the armed watchdog must escape the process
+                            # (mode="hang" blocks here)
+                            self.fault_injector.maybe_fire("sync", self.step)
                         last_metrics = {k: float(v) for k, v in metrics.items()}
                     if monitor is not None:
                         # anomaly policy on the ALREADY-fetched scalars: a
@@ -1460,15 +1565,24 @@ class Trainer:
                             # do NOT checkpoint: under halt the poisoned
                             # update was applied, and auto-resume must find
                             # the last GOOD checkpoint, not this state
-                            logger.error(
-                                "health policy=halt: non-finite step %d "
-                                "(bundle in %s) — stopping without a "
-                                "checkpoint; resume restores the last good "
-                                "save", int(last_metrics.get(
-                                    "health/last_nonfinite_step", -1)),
-                                self.exp.log_dir,
+                            halt_reason = (
+                                f"health policy=halt: non-finite step "
+                                f"{int(last_metrics.get('health/last_nonfinite_step', -1))}"
                             )
-                            halted = True
+                            if control is not None:
+                                # folds through the boundary control word
+                                # below — every host halts at this step even
+                                # if a counter ever diverged across hosts
+                                control.request("health_halt", halt_reason)
+                            else:
+                                logger.error(
+                                    "%s (bundle in %s) — stopping without a "
+                                    "checkpoint; resume restores the last "
+                                    "good save", halt_reason,
+                                    self.exp.log_dir,
+                                )
+                                halted = True
+                                self.stop_class = "health_halt"
                     # throughput window excludes validation/checkpoint/compile
                     # wall time (the spans tagged non-productive) so seq/s and
                     # throughput_peak reflect steady-state training only
@@ -1477,6 +1591,13 @@ class Trainer:
                     )
                     last_metrics["step_time"] = dt
                     last_metrics["consumed_samples"] = self.consumed_samples
+                    ioc = int(getattr(self.data_module, "io_retry_count", 0)
+                              or 0)
+                    if ioc:
+                        # cumulative transient-read retries the prefetch
+                        # thread absorbed (data.io_retries backoff) — a
+                        # flaky mount is visible before it becomes a stall
+                        last_metrics["data/io_retries"] = float(ioc)
                     if tel.spans:
                         last_metrics.update(
                             {f"time/{k}": v for k, v in spans.drain().items()}
@@ -1525,8 +1646,51 @@ class Trainer:
                                 # resume and the reason lands in
                                 # run_summary.json (elastic.stop_reason +
                                 # the alerts trail)
-                                _request_stop(
-                                    f"alert {fire.rule}: {fire.message}")
+                                reason = f"alert {fire.rule}: {fire.message}"
+                                if control is not None:
+                                    # fleet-consistent even on a host-local
+                                    # metric: the stop folds through the
+                                    # control word at THIS boundary
+                                    control.request("alert_halt", reason)
+                                else:
+                                    _request_stop(reason)
+                                    self.stop_class = "alert_halt"
+                    ck_now = False
+                    fold_stop = False
+                    if control is not None:
+                        # THE consensus fold (docs/observability.md "Fleet
+                        # control"): rank 0 polls control/commands.jsonl,
+                        # every host's condition word rides one tiny
+                        # replicated collective, and all hosts apply the
+                        # SAME decision at this step.  This is the
+                        # boundary's only extra cross-host traffic — zero
+                        # new syncs between boundaries.  The fold is itself
+                        # a blocking rendezvous, so it rides the same hang
+                        # guard as the metric fetch: a peer that died
+                        # between its host_sync and its fold must not hang
+                        # the survivors past the watchdog.
+                        with _sync_guard("control_fold"):
+                            decision = control.boundary(self.step)
+                        if decision.dump and monitor is not None:
+                            monitor.dump(
+                                self.step, kind="control",
+                                boundary_metrics=last_metrics,
+                                extra={"control": decision.to_dict()},
+                            )
+                        ck_now = decision.checkpoint_now
+                        if decision.halt:
+                            halted = True
+                            self.stop_class = "health_halt"
+                            logger.error(
+                                "control: fleet-consistent halt at step %d "
+                                "(%s) — stopping WITHOUT a checkpoint; "
+                                "resume restores the last good save",
+                                self.step, decision.reason)
+                        elif decision.stop:
+                            fold_stop = True
+                            self.stop_class = decision.conditions[0]
+                            if stop_requested["reason"] is None:
+                                _request_stop(decision.reason)
 
                     if halted:
                         break
@@ -1543,9 +1707,28 @@ class Trainer:
                     # branch from a re-read would double-save this step —
                     # orbax raises StepAlreadyExistsError.  A notice landing
                     # mid-save stops at the NEXT boundary instead, still
-                    # inside the grace window.
-                    stopping = stop_requested["reason"] is not None
+                    # inside the grace window.  Under the control plane the
+                    # snapshot is the FOLDED decision, not the raw local
+                    # request: a SIGTERM landing after this boundary's fold
+                    # must wait for the next fold, or this host would stop
+                    # alone while its peers saw an empty word — exactly the
+                    # rendezvous mismatch the plane exists to kill.
+                    stopping = (fold_stop if control is not None
+                                else stop_requested["reason"] is not None)
+                    if stopping and self.stop_class is None:
+                        r = str(stop_requested["reason"] or "")
+                        self.stop_class = (
+                            "alert_halt" if r.startswith("alert ")
+                            else "max_time" if r.startswith("max_time")
+                            else "preemption")
                     if ck_every and self.step % ck_every == 0 and not stopping:
+                        with spans.span("checkpoint"):
+                            self.save_checkpoint(last_metrics)
+                    elif ck_now and not stopping:
+                        # operator checkpoint_now (control decision): an
+                        # off-cadence save at the deciding boundary — the
+                        # cadence branch above already covered an on-cadence
+                        # step, and a stop takes the emergency save below
                         with spans.span("checkpoint"):
                             self.save_checkpoint(last_metrics)
                     if stopping:
@@ -1650,6 +1833,11 @@ class Trainer:
                 }
                 if stop_requested["reason"] is not None:
                     section["stop_reason"] = stop_requested["reason"]
+                if self.stop_class is not None:
+                    # the deciding condition class — trainer.control's
+                    # exit-code table maps it to the orchestrator-facing
+                    # exit code
+                    section["stop_class"] = self.stop_class
                 if self.replan_record is not None:
                     section["replan"] = self.replan_record
                 self.exp.write_run_summary({"elastic": section})

@@ -1,0 +1,984 @@
+"""Coordinated fleet control: consensus control word, command channel,
+hang escape, exit-code table (trainer.control — docs/observability.md
+"Fleet control").
+
+The unit half pins the control-word fold semantics (bit OR, decision
+priority, local-vs-fleet reason attribution), the operator command
+parse/dedupe/ack machinery, the knob validation, and the exit-code table.
+The live half drives real tiny-llama ``fit()`` runs: the consensus
+alert-halt drill (local AND simulated-peer hosts stop at the same step
+with a drained emergency save), operator commands landing mid-run, the
+AOT-once + dispatch-ahead contracts with control enabled, and the
+hang-escape path through the armed watchdog (an injected hung boundary
+sync — the in-process test stubs ``os._exit``; the subprocess leg lives
+in ``tools/elastic_drill.py --control-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from neuronx_distributed_training_tpu.config.loader import load_config
+from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
+from neuronx_distributed_training_tpu.trainer.control import (
+    CONDITION_BITS,
+    EXIT_ALERT_HALT,
+    EXIT_ALL_CORRUPT,
+    EXIT_CODES,
+    EXIT_DATA_STALL,
+    EXIT_ELASTIC_REFUSED,
+    EXIT_HANG_ESCAPE,
+    EXIT_HEALTH_HALT,
+    EXIT_OK,
+    ControlConfig,
+    ControlPlane,
+    append_command,
+    commands_path,
+    condition_names,
+    exit_code_for_stop,
+    exit_code_name,
+    fold_word_fleet,
+)
+
+
+# ---------------------------------------------------------------------------
+# knob validation
+# ---------------------------------------------------------------------------
+
+
+class TestControlConfig:
+    def test_defaults_disabled(self):
+        c = ControlConfig.from_config(None)
+        assert not c.enabled and c.poll_commands and c.hang_escape
+        assert c.max_trail == 64
+
+    def test_bool_form(self):
+        assert ControlConfig.from_config(True).enabled
+        assert not ControlConfig.from_config(False).enabled
+
+    def test_unknown_key_did_you_mean(self):
+        with pytest.raises(ValueError, match="hang_escape"):
+            ControlConfig.from_config({"hang_escap": True})
+
+    def test_bad_values(self):
+        with pytest.raises(ValueError, match="boolean"):
+            ControlConfig.from_config({"enabled": "yes"})
+        with pytest.raises(ValueError, match="integer"):
+            ControlConfig.from_config({"max_trail": "many"})
+        with pytest.raises(ValueError, match="integer"):
+            ControlConfig.from_config({"max_trail": True})
+        with pytest.raises(ValueError, match=">= 1"):
+            ControlConfig.from_config({"max_trail": 0})
+        with pytest.raises(ValueError, match="mapping"):
+            ControlConfig.from_config([1, 2])
+
+    def test_nested_in_telemetry(self):
+        t = TelemetryConfig.from_config({"control": {"enabled": True}})
+        assert t.control.enabled
+        assert not TelemetryConfig.from_config({}).control.enabled
+
+    def test_telemetry_bool_keeps_control_disabled(self):
+        assert not TelemetryConfig.from_config(True).control.enabled
+
+    def test_validated_at_config_load(self):
+        with pytest.raises(ValueError, match="control"):
+            load_config({
+                "exp_manager": {"telemetry": {"control": {"enbled": True}}},
+                "model": {"vocab_size": 8, "hidden_size": 8, "num_layers": 1,
+                          "num_attention_heads": 1},
+            })
+
+
+# ---------------------------------------------------------------------------
+# control-word semantics
+# ---------------------------------------------------------------------------
+
+
+class TestControlWord:
+    def test_bits_distinct(self):
+        bits = list(CONDITION_BITS.values())
+        assert len(set(bits)) == len(bits)
+        assert all(b and (b & (b - 1)) == 0 for b in bits)  # powers of two
+
+    def test_condition_names_priority(self):
+        w = CONDITION_BITS["alert_halt"] | CONDITION_BITS["health_halt"]
+        assert condition_names(w) == ["health_halt", "alert_halt"]
+
+    def test_fold_single_process_is_identity(self):
+        # tier-1 runs single-process: the fold must be exact with zero
+        # collective traffic
+        w = CONDITION_BITS["preemption"] | CONDITION_BITS["dump"]
+        assert fold_word_fleet(w) == w
+        assert fold_word_fleet(0) == 0
+
+
+def _plane(tmp_path, **kw):
+    writes: list[dict] = []
+    plane = ControlPlane(
+        ControlConfig(enabled=True), tmp_path,
+        write_run_summary=writes.append, **kw)
+    return plane, writes
+
+
+class TestDecisions:
+    def test_no_conditions_no_decision(self, tmp_path):
+        plane, writes = _plane(tmp_path)
+        d = plane.boundary(4)
+        assert not d.any and not d.stop and not d.halt
+        assert writes == []  # an empty boundary writes nothing
+
+    def test_local_stop_reason_wins(self, tmp_path):
+        plane, writes = _plane(tmp_path)
+        plane.request("preemption", "SIGTERM (preemption)")
+        d = plane.boundary(6)
+        assert d.stop and not d.halt
+        assert d.conditions == ["preemption"]
+        assert d.reason == "SIGTERM (preemption)" and d.source == "local"
+        assert writes and writes[-1]["control"]["decisions"][-1]["stop"]
+
+    def test_halt_beats_stop_and_suppresses_nothing(self, tmp_path):
+        plane, _ = _plane(tmp_path)
+        plane.request("alert_halt", "alert x")
+        plane.request("health_halt", "nonfinite step 3")
+        d = plane.boundary(8)
+        assert d.halt and d.stop
+        assert d.conditions[0] == "health_halt"
+        assert d.reason == "nonfinite step 3"
+
+    def test_remote_bit_reports_fleet_consensus(self, tmp_path):
+        plane, _ = _plane(
+            tmp_path, peer_words=lambda: CONDITION_BITS["alert_halt"])
+        d = plane.boundary(2)
+        assert d.stop and d.source == "fleet"
+        assert d.reason.startswith("fleet consensus: alert_halt")
+
+    def test_peer_words_failure_never_kills(self, tmp_path):
+        def boom():
+            raise RuntimeError("seam broke")
+
+        plane, _ = _plane(tmp_path, peer_words=boom)
+        plane.request("preemption", "notice")
+        assert plane.boundary(1).stop  # local word still decides
+
+    def test_oneshot_bits_clear_after_decision(self, tmp_path):
+        plane, _ = _plane(tmp_path)
+        plane.request("checkpoint_now", "operator")
+        plane.request("dump", "operator")
+        d = plane.boundary(2)
+        assert d.checkpoint_now and d.dump and not d.stop
+        d2 = plane.boundary(4)
+        assert not d2.any  # consumed — no re-fire at the next boundary
+
+    def test_stop_bits_persist(self, tmp_path):
+        plane, _ = _plane(tmp_path)
+        plane.request("operator_stop", "operator command stop")
+        assert plane.boundary(2).stop
+        assert plane.boundary(4).stop  # a stop never un-requests itself
+
+    def test_trail_capped(self, tmp_path):
+        plane = ControlPlane(ControlConfig(enabled=True, max_trail=3),
+                             tmp_path)
+        plane.request("preemption", "notice")
+        for s in range(10):
+            plane.boundary(s)
+        assert len(plane.decisions) == 3
+
+    def test_note_exit_names_condition(self, tmp_path):
+        plane, writes = _plane(tmp_path)
+        plane.note_exit("data_stall", "data_wait exceeded 30s")
+        rec = writes[-1]["control"]["decisions"][-1]
+        assert rec["conditions"] == ["data_stall"] and rec["exit"]
+
+
+# ---------------------------------------------------------------------------
+# operator command channel
+# ---------------------------------------------------------------------------
+
+
+class TestCommands:
+    def test_append_and_accept(self, tmp_path):
+        rec = append_command(tmp_path, "checkpoint_now", note="pre-maint")
+        assert commands_path(tmp_path).exists()
+        plane, writes = _plane(tmp_path)
+        d = plane.boundary(2)
+        assert d.checkpoint_now and not d.stop
+        (ack,) = plane.commands
+        assert ack["id"] == rec["id"] and ack["status"] == "accepted"
+        assert ack["step"] == 2
+        assert writes[-1]["control"]["commands"][-1]["status"] == "accepted"
+
+    def test_unknown_command_refused_at_enqueue(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown control command"):
+            append_command(tmp_path, "reboot")
+
+    def test_stop_command_reason_and_source(self, tmp_path):
+        append_command(tmp_path, "stop", note="maintenance window")
+        plane, _ = _plane(tmp_path)
+        d = plane.boundary(4)
+        assert d.stop and d.source == "operator"
+        assert "operator command stop" in d.reason
+        assert "maintenance window" in d.reason
+
+    def test_dedupe_by_id(self, tmp_path):
+        rec = append_command(tmp_path, "dump")
+        # replay the same line (an operator double-paste / a retried write)
+        with open(commands_path(tmp_path), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        plane, _ = _plane(tmp_path)
+        d = plane.boundary(2)
+        assert d.dump
+        statuses = [a["status"] for a in plane.commands]
+        assert statuses == ["accepted", "duplicate"]
+
+    def test_unknown_command_in_file_acked_unknown(self, tmp_path):
+        with open_commands(tmp_path) as f:
+            f.write(json.dumps({"id": "zz1", "command": "reboot"}) + "\n")
+        plane, _ = _plane(tmp_path)
+        d = plane.boundary(2)
+        assert not d.any
+        (ack,) = plane.commands
+        assert ack["status"] == "unknown" and ack["command"] == "reboot"
+
+    def test_malformed_line_acked_not_dropped(self, tmp_path):
+        with open_commands(tmp_path) as f:
+            f.write("{not json}\n")
+        plane, _ = _plane(tmp_path)
+        plane.boundary(2)
+        (ack,) = plane.commands
+        assert ack["status"] == "malformed"
+
+    def test_torn_tail_line_waits_for_next_poll(self, tmp_path):
+        append_command(tmp_path, "dump")
+        with open_commands(tmp_path) as f:
+            f.write('{"id": "abc", "command": "st')  # no newline: torn
+        plane, _ = _plane(tmp_path)
+        d = plane.boundary(2)
+        assert d.dump and len(plane.commands) == 1
+        with open_commands(tmp_path) as f:
+            f.write('op"}\n')  # the writer finished the line
+        d2 = plane.boundary(4)
+        assert d2.stop  # the completed command lands at the NEXT poll
+
+    def test_incremental_offsets(self, tmp_path):
+        append_command(tmp_path, "dump")
+        plane, _ = _plane(tmp_path)
+        plane.boundary(2)
+        append_command(tmp_path, "checkpoint_now")
+        d = plane.boundary(4)
+        assert d.checkpoint_now and not d.dump  # only the NEW command
+        assert [a["command"] for a in plane.commands] == [
+            "dump", "checkpoint_now"]
+
+    def test_restarted_incarnation_never_replays_acted_commands(
+            self, tmp_path):
+        """A restarted run re-reads commands.jsonl from offset 0: a stop
+        the previous incarnation already obeyed must come back as a
+        `duplicate`, not re-stop the run into a stop/restart loop — the
+        dedupe set re-seeds from the ack trail in run_summary.json."""
+        append_command(tmp_path, "stop")
+        plane1, _ = _plane(tmp_path)
+        assert plane1.boundary(2).stop
+        # persist the trail the way the trainer does
+        (tmp_path / "run_summary.json").write_text(
+            json.dumps({"control": plane1.trail()}))
+        plane2, _ = _plane(tmp_path)
+        d = plane2.boundary(1)
+        assert not d.stop
+        (ack,) = plane2.commands
+        assert ack["status"] == "duplicate"
+
+    def test_poll_disabled_ignores_commands(self, tmp_path):
+        append_command(tmp_path, "stop")
+        plane = ControlPlane(ControlConfig(enabled=True), tmp_path,
+                             poll_commands=False)
+        assert not plane.boundary(2).any  # non-rank-0 hosts never poll
+
+
+def open_commands(run_dir):
+    path = commands_path(run_dir)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    return open(path, "a")
+
+
+# ---------------------------------------------------------------------------
+# exit-code table
+# ---------------------------------------------------------------------------
+
+
+class TestExitCodes:
+    def test_codes_distinct_and_out_of_signal_range(self):
+        codes = list(EXIT_CODES.values())
+        assert len(set(codes)) == len(codes)
+        tagged = [c for c in codes if c not in (0, 1)]
+        # 128+signum is what a signal death reports; stay clear of it
+        assert all(64 <= c < 128 for c in tagged), tagged
+
+    def test_stop_class_mapping(self):
+        assert exit_code_for_stop(None) == EXIT_OK
+        assert exit_code_for_stop("preemption") == EXIT_OK
+        assert exit_code_for_stop("operator_stop") == EXIT_OK
+        assert exit_code_for_stop("max_time") == EXIT_OK
+        assert exit_code_for_stop("health_halt") == EXIT_HEALTH_HALT
+        assert exit_code_for_stop("alert_halt") == EXIT_ALERT_HALT
+        assert exit_code_for_stop("data_stall") == EXIT_DATA_STALL
+
+    def test_names_round_trip(self):
+        assert exit_code_name(EXIT_HANG_ESCAPE) == "hang_escape"
+        assert exit_code_name(EXIT_ALL_CORRUPT) == "all_corrupt"
+        assert exit_code_name(EXIT_ELASTIC_REFUSED) == "elastic_refused"
+        assert exit_code_name(7) == "7"
+
+
+# ---------------------------------------------------------------------------
+# hang-escape machinery (unit)
+# ---------------------------------------------------------------------------
+
+
+class TestHangEscapeUnit:
+    def test_escape_runs_hooks_then_exits(self, tmp_path):
+        from neuronx_distributed_training_tpu.telemetry import HangWatchdog
+
+        events: list = []
+        wd = HangWatchdog(0.05, None, abort=False)
+        wd.arm_escape(EXIT_HANG_ESCAPE,
+                      lambda what, step: events.append(("note", what, step)))
+        wd._exit_fn = lambda code: events.append(("exit", code))
+        with wd.guard("host_sync", 7):
+            time.sleep(0.3)
+        assert ("note", "host_sync", 7) in events
+        assert ("exit", EXIT_HANG_ESCAPE) in events
+
+    def test_hook_failure_never_blocks_exit(self):
+        from neuronx_distributed_training_tpu.telemetry import HangWatchdog
+
+        events: list = []
+
+        def bad_hook(what, step):
+            raise RuntimeError("hook broke")
+
+        wd = HangWatchdog(0.05, None, abort=False)
+        wd.arm_escape(EXIT_HANG_ESCAPE, bad_hook)
+        wd._exit_fn = lambda code: events.append(code)
+        with wd.guard("host_sync", 1):
+            time.sleep(0.3)
+        assert events == [EXIT_HANG_ESCAPE]
+
+    def test_unarmed_watchdog_keeps_legacy_behavior(self):
+        from neuronx_distributed_training_tpu.telemetry import HangWatchdog
+
+        wd = HangWatchdog(0.05, None, abort=False)
+        with wd.guard("host_sync", 1):
+            time.sleep(0.3)
+        assert wd.fired and wd.escape_code is None  # no exit attempted
+
+
+# ---------------------------------------------------------------------------
+# data transient-I/O retry (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDataIoRetry:
+    def test_classifier_walks_cause_chain(self):
+        import errno
+
+        from neuronx_distributed_training_tpu.data.loader import (
+            is_transient_io_error,
+        )
+
+        inner = OSError(errno.ESTALE, "stale NFS handle")
+        outer = RuntimeError("arrow read failed")
+        outer.__cause__ = inner
+        assert is_transient_io_error(outer)
+        assert is_transient_io_error(TimeoutError("slow store"))
+        assert not is_transient_io_error(KeyError("bad column"))
+        assert not is_transient_io_error(OSError(errno.ENOENT, "gone"))
+
+    def test_fetch_retries_then_succeeds(self):
+        import errno
+
+        import numpy as np
+
+        from neuronx_distributed_training_tpu.data.loader import (
+            SyntheticDataModule,
+        )
+
+        dm = SyntheticDataModule(vocab_size=16, seq_len=8,
+                                 global_batch_size=2,
+                                 io_retry_backoff_seconds=0.01)
+        real = dm.fetch_rows
+        fails = {"n": 2}
+
+        def flaky(idx):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(errno.EIO, "flaky mount")
+            return real(idx)
+
+        dm.fetch_rows = flaky
+        batch = next(dm.global_batches())
+        assert isinstance(batch["input_ids"], np.ndarray)
+        assert dm.io_retry_count == 2
+        assert dm.last_io_activity() > 0
+
+    def test_non_transient_raises_immediately(self):
+        from neuronx_distributed_training_tpu.data.loader import (
+            SyntheticDataModule,
+        )
+
+        dm = SyntheticDataModule(vocab_size=16, seq_len=8,
+                                 global_batch_size=2)
+
+        def broken(idx):
+            raise KeyError("missing column")
+
+        dm.fetch_rows = broken
+        with pytest.raises(KeyError):
+            next(dm.global_batches())
+        assert dm.io_retry_count == 0
+
+    def test_retries_exhausted_reraises_the_real_error(self):
+        import errno
+
+        from neuronx_distributed_training_tpu.data.loader import (
+            SyntheticDataModule,
+        )
+
+        dm = SyntheticDataModule(vocab_size=16, seq_len=8,
+                                 global_batch_size=2, io_retries=2,
+                                 io_retry_backoff_seconds=0.01)
+
+        def always(idx):
+            raise OSError(errno.EIO, "dead mount")
+
+        dm.fetch_rows = always
+        with pytest.raises(OSError, match="dead mount"):
+            next(dm.global_batches())
+        assert dm.io_retry_count == 2  # bounded — not infinite
+
+    def test_stall_deferred_while_retrying(self):
+        """DataStallError fires only after retries are exhausted: a fresh
+        activity timestamp from the retry loop defers the stall verdict."""
+        import threading
+
+        from neuronx_distributed_training_tpu.data.loader import (
+            DataStallError,
+            PrefetchIterator,
+        )
+
+        activity = {"t": 0.0}
+        release = threading.Event()
+
+        def slow():
+            release.wait(10.0)
+            yield {"x": 1}
+
+        it = PrefetchIterator(slow(), timeout_seconds=0.3,
+                              activity_fn=lambda: activity["t"])
+
+        def keep_active():
+            for _ in range(8):
+                activity["t"] = time.monotonic()
+                time.sleep(0.1)
+            release.set()
+
+        t = threading.Thread(target=keep_active)
+        t.start()
+        try:
+            assert next(it) == {"x": 1}  # survived ~0.8s > timeout 0.3s
+        finally:
+            t.join()
+            it.close()
+
+    def test_stall_deferred_through_backoff_longer_than_timeout(self):
+        """A single backoff delay LONGER than the stall timeout must still
+        defer the verdict: the retry loop refreshes its activity timestamp
+        in sub-timeout slices while sleeping."""
+        import errno
+
+        from neuronx_distributed_training_tpu.data.loader import (
+            PrefetchIterator,
+            SyntheticDataModule,
+        )
+
+        dm = SyntheticDataModule(vocab_size=16, seq_len=8,
+                                 global_batch_size=2, io_retries=1,
+                                 io_retry_backoff_seconds=0.8)
+        real = dm.fetch_rows
+        fails = {"n": 1}
+
+        def flaky(idx):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(errno.EIO, "flaky mount")
+            return real(idx)
+
+        dm.fetch_rows = flaky
+        it = PrefetchIterator(dm.global_batches(), timeout_seconds=0.3,
+                              activity_fn=dm.last_io_activity)
+        try:
+            batch = next(it)  # 0.8s backoff > 0.3s timeout: no stall
+            assert batch["input_ids"].shape == (2, 8)
+        finally:
+            it.close()
+
+    def test_stall_fires_when_activity_goes_silent(self):
+        from neuronx_distributed_training_tpu.data.loader import (
+            DataStallError,
+            PrefetchIterator,
+        )
+
+        def never():
+            time.sleep(30)
+            yield {}
+
+        it = PrefetchIterator(never(), timeout_seconds=0.2,
+                              activity_fn=lambda: 0.0)
+        with pytest.raises(DataStallError):
+            next(it)
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# live fit() integration
+# ---------------------------------------------------------------------------
+
+
+def _ctl_cfg(tmp_path, **over):
+    cfg = {
+        "name": "ctl",
+        "trainer": {"max_steps": 6, "log_every_n_steps": 2},
+        "exp_manager": {"exp_dir": str(tmp_path / "exp"),
+                        "create_tensorboard_logger": False,
+                        "log_files": False,
+                        "telemetry": {"control": {"enabled": True}}},
+        "distributed_strategy": {"tensor_model_parallel_size": 1},
+        "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                 "seq_length": 32, "synthetic": True},
+        "model": {"vocab_size": 128, "hidden_size": 64,
+                  "intermediate_size": 128, "num_layers": 2,
+                  "num_attention_heads": 4, "num_key_value_heads": 2,
+                  "max_position_embeddings": 32,
+                  "optim": {"name": "adamw_fp32OptState", "lr": 1e-3}},
+        "precision": {"type": "mixed_precision"},
+    }
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(cfg.get(k), dict):
+            cfg[k] = {**cfg[k], **v}
+        else:
+            cfg[k] = v
+    return load_config(cfg)
+
+
+def _summary(t):
+    return json.loads(
+        (Path(str(t.exp.log_dir)) / "run_summary.json").read_text())
+
+
+class TestControlLive:
+    def test_consensus_alert_halt_same_step_and_emergency_save(
+            self, tmp_path, devices8):
+        """The acceptance scenario: an action:halt alert on a
+        NON-replicated metric (data_wait — a host-local span) stops the
+        deciding host at a deterministic boundary WITH a drained emergency
+        save, and a second simulated host that sees only the folded
+        control word stops at the SAME step."""
+        from neuronx_distributed_training_tpu.trainer.control import (
+            CONDITION_BITS,
+        )
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        # leg 1: the deciding host (alert fires locally)
+        cfg = _ctl_cfg(
+            tmp_path / "local",
+            exp_manager={
+                "exp_dir": str(tmp_path / "local"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "checkpoint_callback_params": {
+                    "every_n_train_steps": 10, "save_top_k": 2,
+                    "async_checkpointing": False},
+                "telemetry": {
+                    "control": {"enabled": True},
+                    "alerts": [{"metric": "data_wait", "threshold": 1e-12,
+                                "action": "halt", "name": "dw"}],
+                }})
+        t = Trainer.from_config(cfg)
+        t.fit()
+        assert t.step == 2  # the first deterministic boundary
+        assert t.stop_class == "alert_halt"
+        rs = _summary(t)
+        assert rs["elastic"]["stop_reason"].startswith("alert dw:")
+        assert rs["elastic"]["stop_class"] == "alert_halt"
+        dec = rs["control"]["decisions"][-1]
+        assert dec["step"] == 2 and dec["stop"]
+        assert dec["conditions"] == ["alert_halt"]
+        assert dec["source"] == "local"
+        # the drained emergency save exists at the stop step even though
+        # the cadence (every 10) never reached it
+        ck = Path(str(t.exp.log_dir)) / "checkpoints"
+        assert "2" in {p.name for p in ck.iterdir()}
+
+        # leg 2: a simulated OTHER host — no local condition, only the
+        # folded word — stops at the SAME boundary, honestly attributed
+        cfg2 = _ctl_cfg(tmp_path / "peer")
+        t2 = Trainer.from_config(cfg2, enable_checkpointing=False)
+        t2.control_peer_words = lambda: CONDITION_BITS["alert_halt"]
+        t2.fit()
+        assert t2.step == 2  # SAME deciding step
+        rs2 = _summary(t2)
+        assert rs2["elastic"]["stop_reason"].startswith("fleet consensus:")
+        dec2 = rs2["control"]["decisions"][-1]
+        assert dec2["source"] == "fleet" and dec2["step"] == 2
+
+    def test_operator_stop_command(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(tmp_path)
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        append_command(Path(str(t.exp.log_dir)), "stop", note="maint")
+        t.fit()
+        assert t.step == 2 and t.stop_class == "operator_stop"
+        rs = _summary(t)
+        assert "operator command stop" in rs["elastic"]["stop_reason"]
+        (ack,) = rs["control"]["commands"]
+        assert ack["status"] == "accepted" and ack["step"] == 2
+
+    def test_operator_checkpoint_now_off_cadence(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(
+            tmp_path,
+            exp_manager={
+                "exp_dir": str(tmp_path / "exp"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "checkpoint_callback_params": {
+                    "every_n_train_steps": 10, "save_top_k": 3,
+                    "async_checkpointing": False},
+                "telemetry": {"control": {"enabled": True}}})
+        t = Trainer.from_config(cfg)
+        append_command(Path(str(t.exp.log_dir)), "checkpoint_now")
+        t.fit()
+        assert t.step == 6  # run completes — checkpoint_now never stops
+        ck = Path(str(t.exp.log_dir)) / "checkpoints"
+        steps = {p.name for p in ck.iterdir() if p.name.isdigit()}
+        assert "2" in steps  # the off-cadence operator save
+        rs = _summary(t)
+        dec = [d for d in rs["control"]["decisions"]
+               if d.get("checkpoint_now")]
+        assert dec and dec[0]["step"] == 2
+
+    def test_operator_dump_writes_control_bundle(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(tmp_path)
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        append_command(Path(str(t.exp.log_dir)), "dump")
+        t.fit()
+        assert t.step == 6
+        d = Path(str(t.exp.log_dir))
+        bundles = sorted(p.name for p in d.glob("control_*"))
+        assert bundles == ["control_00000002"]
+        payload = json.loads((d / bundles[0] / "anomaly.json").read_text())
+        assert payload["kind"] == "control"
+        assert payload["control"]["conditions"] == ["dump"]
+
+    def test_health_halt_folds_through_consensus(self, tmp_path, devices8):
+        """health policy=halt with control enabled: the halt bit rides the
+        word, the decision halts WITHOUT a checkpoint, and the exit class
+        maps to EXIT_HEALTH_HALT."""
+        from neuronx_distributed_training_tpu.trainer.control import (
+            CONDITION_BITS,
+        )
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        # simulate the halt arriving from ANOTHER host (replicated health
+        # counters make the local path identical; the peer form also pins
+        # the no-checkpoint semantics for a remote-only halt)
+        cfg = _ctl_cfg(
+            tmp_path,
+            exp_manager={
+                "exp_dir": str(tmp_path / "exp"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "checkpoint_callback_params": {
+                    "every_n_train_steps": 10, "save_top_k": 2,
+                    "async_checkpointing": False},
+                "telemetry": {"control": {"enabled": True}}})
+        t = Trainer.from_config(cfg)
+        t.control_peer_words = lambda: CONDITION_BITS["health_halt"]
+        t.fit()
+        assert t.step == 2 and t.stop_class == "health_halt"
+        assert exit_code_for_stop(t.stop_class) == EXIT_HEALTH_HALT
+        ck = Path(str(t.exp.log_dir)) / "checkpoints"
+        steps = ({p.name for p in ck.iterdir() if p.name.isdigit()}
+                 if ck.exists() else set())
+        assert "2" not in steps  # halt NEVER checkpoints the poisoned state
+
+    def test_aot_once_and_dispatch_ahead_with_control(self, tmp_path,
+                                                      devices8):
+        """Control enabled must add ZERO host syncs between boundaries and
+        keep the AOT-once contract — the same instrumented-step proof the
+        fleet layer pins, with the control plane on."""
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(
+            tmp_path,
+            trainer={"max_steps": 6, "log_every_n_steps": 3},
+            exp_manager={
+                "exp_dir": str(tmp_path / "exp"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "telemetry": {"control": {"enabled": True},
+                              "fleet": {"enabled": True},
+                              "alerts": [{"metric": "loss",
+                                          "threshold": 1e9}]}})
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        assert not hasattr(t.train_step, "lower") or True  # pre-census
+
+        conversions: list[int] = []
+
+        class _Scalar:
+            def __init__(self, step):
+                self.step = step
+
+            def __float__(self):
+                conversions.append(self.step)
+                return 1.0
+
+        real_params, real_opt = t.params, t.opt_state
+
+        def fake_step(params, opt_state, batch, key):
+            return real_params, real_opt, {"loss": _Scalar(t.step),
+                                           "grad_norm": _Scalar(t.step)}
+
+        t.train_step = fake_step
+        t.fit()
+        assert conversions, "boundaries must fetch metrics"
+        assert set(conversions) == {2, 5}, conversions
+
+    def test_aot_once_with_control_enabled(self, tmp_path, devices8):
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(
+            tmp_path,
+            exp_manager={
+                "exp_dir": str(tmp_path / "exp"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "telemetry": {"control": {"enabled": True},
+                              "compile_census": True}})
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        t.fit()
+        # the census swapped in the AOT executable; the control plane
+        # added no recompile (the retrace detector would have logged)
+        assert not hasattr(t.train_step, "lower")
+        assert t.step == 6
+
+    def test_hang_escape_through_real_fit(self, tmp_path, devices8):
+        """An injected hung boundary sync (the dead-peer stand-in): the
+        armed watchdog dumps the hang bundle, writes the dying beacon +
+        control exit note, and calls the exit fn with EXIT_HANG_ESCAPE.
+        The exit fn is stubbed (the real ``os._exit`` leg lives in
+        ``elastic_drill.py --control-smoke``); the injected hang then
+        unblocks and the run finishes, letting us assert the artifacts."""
+        from neuronx_distributed_training_tpu.telemetry import (
+            flight_recorder,
+        )
+        from neuronx_distributed_training_tpu.trainer.elastic import (
+            FaultInjector,
+        )
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(
+            tmp_path,
+            trainer={"max_steps": 4, "log_every_n_steps": 2},
+            exp_manager={
+                "exp_dir": str(tmp_path / "exp"),
+                "create_tensorboard_logger": False, "log_files": False,
+                "telemetry": {
+                    "control": {"enabled": True},
+                    "fleet": {"enabled": True},
+                    "health": {"watchdog_timeout_seconds": 0.5,
+                               "watchdog_abort": False},
+                }})
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        t.fault_injector = FaultInjector(at_step=2, mode="hang",
+                                         phase="sync", hang_seconds=2.0)
+        exits: list[int] = []
+        orig_init = flight_recorder.HangWatchdog.arm_escape
+
+        def spy_arm(self, code, *hooks):
+            orig_init(self, code, *hooks)
+            self._exit_fn = exits.append
+
+        try:
+            flight_recorder.HangWatchdog.arm_escape = spy_arm
+            t.fit()
+        finally:
+            flight_recorder.HangWatchdog.arm_escape = orig_init
+        assert exits == [EXIT_HANG_ESCAPE]
+        d = Path(str(t.exp.log_dir))
+        assert sorted(p.name for p in d.glob("hang_*")) == ["hang_00000002"]
+        beacons = [json.loads(l) for l in
+                   (d / "fleet" / "host_0.jsonl").read_text().splitlines()]
+        dying = [b for b in beacons if b.get("last_exception")]
+        assert dying and "hang escape" in dying[0]["last_exception"]
+        rs = json.loads((d / "run_summary.json").read_text())
+        note = [x for x in rs["control"]["decisions"] if x.get("exit")]
+        assert note and note[0]["conditions"] == ["hang_escape"]
+
+    def test_io_retries_surface_as_boundary_metric(self, tmp_path,
+                                                   devices8):
+        import errno
+
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ctl_cfg(
+            tmp_path,
+            data={"global_batch_size": 8, "micro_batch_size": 1,
+                  "seq_length": 32, "synthetic": True,
+                  "io_retry_backoff_seconds": 0.01})
+        t = Trainer.from_config(cfg, enable_checkpointing=False)
+        assert t.data_module.io_retry_backoff_seconds == 0.01  # knob landed
+        real = t.data_module.fetch_rows
+        fails = {"n": 2}
+
+        def flaky(idx):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(errno.EIO, "flaky mount")
+            return real(idx)
+
+        t.data_module.fetch_rows = flaky
+        t.fit()
+        assert t.step == 6
+        recs = [json.loads(l) for l in
+                (Path(str(t.exp.log_dir)) / "metrics.jsonl")
+                .read_text().splitlines()]
+        vals = [r.get("data/io_retries") for r in recs
+                if "data/io_retries" in r]
+        assert vals and vals[-1] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestControlDrill:
+    @pytest.mark.slow
+    def test_control_smoke_matrix(self, tmp_path, devices8):
+        """The full acceptance matrix through real tiny-llama fit()s (the
+        ``elastic_drill.py --control-smoke`` CI gate): consensus stop on
+        both simulated hosts at the same step, subprocess hang escape with
+        the real ``os._exit`` and the tagged code, bitwise resume."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            str(Path(__file__).parent.parent), "tools"))
+        from elastic_drill import run_control_drill
+
+        report = run_control_drill(tmp_path)
+        assert report["ok"]
+        assert report["hang_escape_code"] == EXIT_HANG_ESCAPE
+        assert report["max_loss_diff"] == 0.0
+
+
+class TestRunCtlCLI:
+    def _load(self):
+        import importlib.util
+        import sys
+
+        path = (Path(__file__).parent.parent / "tools" / "run_ctl.py")
+        spec = importlib.util.spec_from_file_location("_run_ctl", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_run_ctl"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_enqueue_json_last_line(self, tmp_path, capsys):
+        mod = self._load()
+        rc = mod.main([str(tmp_path), "checkpoint_now", "--note", "x",
+                       "--json", "-"])
+        assert rc == 0
+        last = capsys.readouterr().out.strip().splitlines()[-1]
+        payload = json.loads(last)
+        assert payload["ok"] and payload["command"] == "checkpoint_now"
+        # the enqueued line is on disk, parseable, with the same id
+        (line,) = commands_path(tmp_path).read_text().splitlines()
+        assert json.loads(line)["id"] == payload["id"]
+
+    def test_list_joins_acks(self, tmp_path, capsys):
+        mod = self._load()
+        rec = append_command(tmp_path, "stop")
+        # a run recorded the ack in run_summary.json
+        (tmp_path / "run_summary.json").write_text(json.dumps({
+            "control": {"commands": [{"id": rec["id"], "command": "stop",
+                                      "step": 4, "status": "accepted"}],
+                        "decisions": []}}))
+        rc = mod.main([str(tmp_path), "list", "--json", "-"])
+        assert rc == 0
+        last = capsys.readouterr().out.strip().splitlines()[-1]
+        payload = json.loads(last)
+        (cmd,) = payload["commands"]
+        assert cmd["status"] == "accepted" and cmd["acked_step"] == 4
+
+    def test_missing_run_dir(self, tmp_path):
+        mod = self._load()
+        assert mod.main([str(tmp_path / "nope"), "stop"]) == 2
+
+
+class TestReportRendering:
+    def test_metrics_report_control_section(self, tmp_path, capsys):
+        import importlib.util
+        import sys
+
+        path = (Path(__file__).parent.parent / "tools" / "metrics_report.py")
+        spec = importlib.util.spec_from_file_location("_mr_ctl", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_mr_ctl"] = mod
+        spec.loader.exec_module(mod)
+        (tmp_path / "metrics.jsonl").write_text(
+            '{"step": 2, "loss": 1.0}\n')
+        (tmp_path / "run_summary.json").write_text(json.dumps({
+            "alerts": [{"step": 2, "rule": "dw", "action": "halt",
+                        "message": "data_wait high"}],
+            "control": {
+                "commands": [{"id": "abc", "command": "stop", "step": 2,
+                              "status": "accepted"}],
+                "decisions": [{"step": 2, "stop": True,
+                               "conditions": ["alert_halt"],
+                               "source": "local",
+                               "reason": "alert dw: data_wait high"}]},
+        }))
+        assert mod.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "fleet control" in out
+        assert "command stop" in out and "accepted" in out
+        assert "[alert_halt]" in out and "alert dw" in out
+
+    def test_fleet_monitor_renders_control_next_to_findings(
+            self, tmp_path, capsys):
+        import importlib.util
+        import sys
+
+        path = (Path(__file__).parent.parent / "tools" / "fleet_monitor.py")
+        spec = importlib.util.spec_from_file_location("_fm_ctl", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_fm_ctl"] = mod
+        spec.loader.exec_module(mod)
+        fleet = tmp_path / "fleet"
+        fleet.mkdir()
+        (fleet / "host_0.jsonl").write_text(json.dumps({
+            "host": 0, "step": 2, "t_mono": 1.0, "t_wall": 1.0,
+            "metrics": {"loss": 1.0}}) + "\n")
+        (tmp_path / "run_summary.json").write_text(json.dumps({
+            "control": {"commands": [],
+                        "decisions": [{"step": 2, "stop": True,
+                                       "conditions": ["preemption"],
+                                       "source": "fleet",
+                                       "reason": "fleet consensus"}]}}))
+        mod.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "fleet control" in out and "[preemption]" in out

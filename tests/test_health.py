@@ -78,12 +78,26 @@ class TestHealthConfig:
         with pytest.raises(ValueError, match="max_bundles"):
             HealthConfig.from_config({"max_bundles": 0})
 
-    def test_watchdog_without_enabled_rejected(self):
+    def test_watchdog_without_any_monitor_rejected(self):
         # a watchdog that silently never arms is worse than a loud config
-        # error — the dump path needs the (enabled-gated) flight recorder
-        with pytest.raises(ValueError, match="enabled"):
-            HealthConfig.from_config({"enabled": False,
-                                      "watchdog_timeout_seconds": 300})
+        # error — the dump path needs a bundle-capable monitor, which any
+        # of health / fleet / control / a dump-action alert rule arms (the
+        # cross-block check lives in TelemetryConfig, which sees them all)
+        with pytest.raises(ValueError, match="bundle-capable"):
+            TelemetryConfig.from_config({"health": {
+                "enabled": False, "watchdog_timeout_seconds": 300}})
+        # ...and each bundle-capable block legalizes it
+        for block in ({"health": {"enabled": True,
+                                  "watchdog_timeout_seconds": 300}},
+                      {"health": {"watchdog_timeout_seconds": 300},
+                       "fleet": {"enabled": True}},
+                      {"health": {"watchdog_timeout_seconds": 300},
+                       "control": {"enabled": True}},
+                      {"health": {"watchdog_timeout_seconds": 300},
+                       "alerts": [{"metric": "loss", "threshold": 1.0,
+                                   "action": "dump"}]}):
+            t = TelemetryConfig.from_config(block)
+            assert t.health.watchdog_timeout_seconds == 300.0
 
     def test_blanket_telemetry_off_keeps_health_disabled(self):
         assert TelemetryConfig.from_config(False).health.enabled is False

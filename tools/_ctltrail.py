@@ -1,0 +1,49 @@
+"""Shared renderer for the fleet-control trail (``run_summary.json``'s
+``control`` section — trainer.control, docs/observability.md "Fleet
+control").
+
+Both ``tools/metrics_report.py`` and ``tools/fleet_monitor.py`` render the
+operator-command acks and consensus decisions; one formatter keeps the two
+from drifting when the trail schema grows a key.  Stdlib-only, like every
+module the login-node tools load.
+"""
+
+from __future__ import annotations
+
+
+def decision_action(d: dict) -> str:
+    """The decision's one-word action for a terminal column."""
+    if d.get("halt"):
+        return "halt"
+    if d.get("stop"):
+        return "stop"
+    oneshot = "/".join(k for k in ("checkpoint_now", "dump") if d.get(k))
+    return oneshot or "note"
+
+
+def control_trail_lines(ctl: dict) -> list[str]:
+    """Body lines (no header) for a ``control`` trail dict: one line per
+    command ack, one per decision.  Unreadable entries render instead of
+    aborting the report."""
+    lines: list[str] = []
+    for c in ctl.get("commands") or []:
+        if not isinstance(c, dict):
+            lines.append(f"  (unreadable command entry: {c!r})")
+            continue
+        lines.append(f"  command {str(c.get('command', '?')):<15} "
+                     f"id={str(c.get('id', '?')):<13} "
+                     f"{str(c.get('status', '?')):<9} "
+                     f"@ step {c.get('step', '?')}"
+                     + (f"  ({c['note']})" if c.get("note") else ""))
+    for d in ctl.get("decisions") or []:
+        if not isinstance(d, dict):
+            lines.append(f"  (unreadable decision entry: {d!r})")
+            continue
+        conds = ",".join(d.get("conditions") or []) or "?"
+        where = "exit" if d.get("exit") else f"step {d.get('step', '?')}"
+        lines.append(f"  decision @ {where:<9} {decision_action(d):<14} "
+                     f"[{conds}] source={str(d.get('source', '?')):<8} "
+                     f"{d.get('reason', '')}")
+    if not lines:
+        lines.append("  (enabled; no commands or decisions recorded)")
+    return lines

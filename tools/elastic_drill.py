@@ -121,7 +121,8 @@ def _run_dir(cfg: Any) -> Path:
 
 def run_segment(raw_cfg: dict, devices: list, *,
                 fault: Optional[Any] = None,
-                replan_world: Optional[int] = None) -> dict[str, Any]:
+                replan_world: Optional[int] = None,
+                peer_words: Optional[Any] = None) -> dict[str, Any]:
     """One trainer incarnation of the drill: build (optionally after a
     restart-time replan for ``replan_world`` chips), attach the fault
     injector, run ``fit()``, and report what happened.
@@ -149,6 +150,11 @@ def run_segment(raw_cfg: dict, devices: list, *,
         trainer.discovery_integrity_trail = itrail
     if fault is not None:
         trainer.fault_injector = fault
+    if peer_words is not None:
+        # the control plane's simulated-peer seam: extra control-word bits
+        # standing in for other hosts' contributions on this single-process
+        # mesh (trainer.control, docs/observability.md "Fleet control")
+        trainer.control_peer_words = peer_words
     killed, metrics = False, None
     try:
         metrics = trainer.fit()
@@ -482,12 +488,243 @@ def run_corruption_drill(workdir: str | Path, *, kind: str = "byte_flip",
     }
 
 
+def control_drill_config(workdir: str | Path, *, max_steps: int = 6,
+                         save_every: int = 2, log_every: int = 1,
+                         alerts: Optional[list] = None,
+                         watchdog_seconds: float = 0.0) -> dict[str, Any]:
+    """The control drill's tiny-llama config: the elastic drill config plus
+    the fleet control plane (consensus control word), the fleet beacon
+    plane (dying final beacons), and — for the hang leg — the armed hang
+    watchdog.  Synchronous checkpointing: the hang leg ``os._exit``\\ s, so
+    the last good save must already be committed, not in flight."""
+    cfg = tiny_llama_config(workdir, max_steps=max_steps,
+                            save_every=save_every)
+    cfg["trainer"]["log_every_n_steps"] = log_every
+    cfg["exp_manager"]["checkpoint_callback_params"][
+        "async_checkpointing"] = False
+    tel = cfg["exp_manager"]["telemetry"]
+    tel["control"] = {"enabled": True}
+    tel["fleet"] = {"enabled": True, "stale_after_seconds": 300.0}
+    if alerts:
+        tel["alerts"] = alerts
+    if watchdog_seconds > 0:
+        tel["health"] = {"watchdog_timeout_seconds": watchdog_seconds,
+                         "watchdog_abort": False}
+    return cfg
+
+
+def run_control_drill(workdir: str | Path, *, world: int = 4,
+                      total_steps: int = 6, save_every: int = 2,
+                      hang_timeout_seconds: float = 240.0) -> dict[str, Any]:
+    """The fleet-control acceptance drill (docs/observability.md "Fleet
+    control") — the two ISSUE scenarios on the virtual CPU mesh:
+
+    **Consensus stop** — an ``action: halt`` alert firing on ONE simulated
+    host's non-replicated metric (``data_wait``, a span only that host
+    times) must stop ALL hosts at the same deterministic boundary with a
+    drained emergency save and the stop reason in ``run_summary.json``.
+    Three legs: the host where the alert fires locally; a second simulated
+    host that sees ONLY the folded control word (the ``peer_words`` seam)
+    and must stop at the same boundary step with source ``fleet``; and the
+    resumed incarnation proving loss-trajectory continuity to the control
+    run.
+
+    **Collective-hang escape** — a subprocess incarnation whose boundary
+    sync hangs (``FaultInjector(mode="hang", phase="sync")`` — the dead
+    peer mid-collective) must exit with the tagged ``EXIT_HANG_ESCAPE``
+    code within the watchdog timeout, leaving the ``hang_<step>/`` bundle,
+    a dying final beacon, and the control-trail exit note; the restarted
+    incarnation resumes from the last good save with loss continuity.
+    """
+    import subprocess
+
+    import jax
+
+    from neuronx_distributed_training_tpu.config.loader import load_config
+    from neuronx_distributed_training_tpu.trainer.control import (
+        CONDITION_BITS,
+        EXIT_ALERT_HALT,
+        EXIT_HANG_ESCAPE,
+        exit_code_for_stop,
+    )
+
+    devices = jax.devices()
+    if world > len(devices):
+        raise ValueError(f"drill wants {world} devices, have {len(devices)}")
+    workdir = Path(workdir)
+    halt_alert = [{"metric": "data_wait", "threshold": 1e-12,
+                   "action": "halt", "name": "dw"}]
+
+    # 0. control: an uninterrupted run for the continuity bar
+    control = run_segment(
+        tiny_llama_config(workdir / "control", max_steps=total_steps,
+                          save_every=save_every),
+        devices[:world])
+    assert control.get("metrics"), "control run produced no metrics"
+    control_losses = read_losses(control["run_dir"])
+
+    # 1a. consensus stop, deciding host: the alert fires on THIS host's
+    # non-replicated data_wait span; the stop folds through the control
+    # word and takes the drained emergency save at the same boundary
+    local_cfg = control_drill_config(workdir / "consensus",
+                                     max_steps=total_steps,
+                                     save_every=save_every,
+                                     alerts=halt_alert)
+    local = run_segment(local_cfg, devices[:world])
+    t = local["trainer"]
+    assert t.stop_class == "alert_halt", t.stop_class
+    assert exit_code_for_stop(t.stop_class) == EXIT_ALERT_HALT
+    stop_step = int(t.step)
+    rs = json.loads(
+        (Path(local["run_dir"]) / "run_summary.json").read_text())
+    assert rs["elastic"]["stop_reason"].startswith("alert dw:"), rs["elastic"]
+    assert rs["elastic"]["stop_class"] == "alert_halt", rs["elastic"]
+    decisions = rs["control"]["decisions"]
+    assert decisions and decisions[-1]["conditions"] == ["alert_halt"], (
+        decisions)
+    assert decisions[-1]["step"] == stop_step and decisions[-1]["stop"], (
+        decisions)
+    ck_dir = Path(local["run_dir"]) / "checkpoints"
+    assert str(stop_step) in {p.name for p in ck_dir.iterdir()}, (
+        f"no drained emergency save at stop step {stop_step}: "
+        f"{sorted(p.name for p in ck_dir.iterdir())}")
+
+    # 1b. consensus stop, OTHER host: no local condition at all — only the
+    # folded control word (peer_words stands in for the deciding host's
+    # contribution).  Must stop at the SAME deterministic boundary step,
+    # with an emergency save and the honest "fleet consensus" reason.
+    peer_cfg = control_drill_config(workdir / "peer", max_steps=total_steps,
+                                    save_every=save_every)
+    peer = run_segment(peer_cfg, devices[:world],
+                       peer_words=lambda: CONDITION_BITS["alert_halt"])
+    pt = peer["trainer"]
+    assert int(pt.step) == stop_step, (
+        f"peer host stopped at step {pt.step}, deciding host at "
+        f"{stop_step} — NOT the same boundary")
+    prs = json.loads(
+        (Path(peer["run_dir"]) / "run_summary.json").read_text())
+    assert prs["elastic"]["stop_reason"].startswith("fleet consensus:"), (
+        prs["elastic"])
+    pdec = prs["control"]["decisions"][-1]
+    assert pdec["source"] == "fleet" and pdec["step"] == stop_step, pdec
+    pck = Path(peer["run_dir"]) / "checkpoints"
+    assert str(stop_step) in {p.name for p in pck.iterdir()}, (
+        "peer host took no emergency save")
+
+    # 1c. the resumed incarnation (alert disarmed — the operator fixed the
+    # condition) continues from the emergency save to the horizon with
+    # loss-trajectory continuity vs the uninterrupted control
+    resume_cfg = control_drill_config(workdir / "consensus",
+                                      max_steps=total_steps,
+                                      save_every=save_every)
+    resumed = run_segment(resume_cfg, devices[:world])
+    assert resumed.get("metrics"), "resumed run produced no metrics"
+    drill_losses = read_losses(resumed["run_dir"])
+    common = sorted(set(control_losses) & set(drill_losses))
+    assert common and max(common) == total_steps, (
+        f"resumed run never reached step {total_steps}: "
+        f"{sorted(drill_losses)}")
+    worst = max(abs(control_losses[s] - drill_losses[s]) for s in common)
+    assert worst == 0.0, (
+        f"same-world consensus resume must be bitwise: max |Δloss| "
+        f"{worst:.3e} over steps {common}")
+
+    # 2. collective-hang escape: the doomed incarnation runs in a CHILD
+    # process (the escape is a real os._exit) with its boundary sync hung
+    # at step 4 — the watchdog must exit EXIT_HANG_ESCAPE well before the
+    # injected 60 s sleep ends
+    hang_cfg = control_drill_config(workdir / "hang", max_steps=total_steps,
+                                    save_every=save_every,
+                                    watchdog_seconds=2.0)
+    cfg_path = workdir / "hang_cfg.json"
+    cfg_path.write_text(json.dumps(hang_cfg))
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--hang-child",
+         str(cfg_path), "--world", str(world), "--at-step", "4"],
+        timeout=hang_timeout_seconds, capture_output=True, text=True,
+    )
+    assert child.returncode == EXIT_HANG_ESCAPE, (
+        f"hung incarnation exited {child.returncode}, want "
+        f"EXIT_HANG_ESCAPE={EXIT_HANG_ESCAPE}\n--- child stderr ---\n"
+        + child.stderr[-2000:])
+    hang_run = _run_dir(load_config(hang_cfg))
+    bundles = sorted(p.name for p in hang_run.glob("hang_*"))
+    assert bundles, f"no hang_<step>/ bundle in {hang_run}"
+    beacons = [json.loads(l) for l in
+               (hang_run / "fleet" / "host_0.jsonl").read_text().splitlines()]
+    assert beacons and "hang escape" in str(
+        beacons[-1].get("last_exception")), (
+        f"final beacon is not a dying one: {beacons[-1]}")
+    hrs = json.loads((hang_run / "run_summary.json").read_text())
+    hdec = hrs["control"]["decisions"][-1]
+    assert hdec["conditions"] == ["hang_escape"] and hdec.get("exit"), hdec
+
+    # 3. the restarted incarnation resumes from the last good save and
+    # finishes with loss continuity — the orchestrator's restart IS the
+    # recovery, exactly as elastic resume promises
+    hang_resumed = run_segment(
+        control_drill_config(workdir / "hang", max_steps=total_steps,
+                             save_every=save_every),
+        devices[:world])
+    assert hang_resumed.get("metrics"), "hang-resumed run has no metrics"
+    hlosses = read_losses(hang_resumed["run_dir"])
+    hcommon = sorted(set(control_losses) & set(hlosses))
+    assert hcommon and max(hcommon) == total_steps, sorted(hlosses)
+    hworst = max(abs(control_losses[s] - hlosses[s]) for s in hcommon)
+    assert hworst == 0.0, (
+        f"post-hang-escape resume diverged: max |Δloss| {hworst:.3e}")
+
+    import time
+
+    return {
+        "ok": True,
+        "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "world": world,
+        "total_steps": total_steps,
+        "consensus_stop_step": stop_step,
+        "consensus_sources": ["local", "fleet"],
+        "hang_escape_code": int(child.returncode),
+        "hang_bundle": bundles[0],
+        "max_loss_diff": max(worst, hworst),
+        "run_dir": str(resumed["run_dir"]),
+    }
+
+
+def _hang_child(cfg_path: str, world: int, at_step: int) -> int:
+    """The doomed incarnation of the hang leg (runs in a subprocess): its
+    boundary sync blocks via ``FaultInjector(mode="hang", phase="sync")``;
+    the armed watchdog must dump, beacon, and ``os._exit(EXIT_HANG_ESCAPE)``
+    — so reaching the end of this function is itself a drill failure."""
+    import jax
+
+    from neuronx_distributed_training_tpu.trainer.elastic import FaultInjector
+
+    raw = json.loads(Path(cfg_path).read_text())
+    fault = FaultInjector(at_step=at_step, mode="hang", phase="sync",
+                          hang_seconds=60.0)
+    run_segment(raw, jax.devices()[:world], fault=fault)
+    logger.error("hang child SURVIVED the hung sync — watchdog escape "
+                 "did not fire")
+    return 3
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: the canonical dp 4 -> 2 kill drill PLUS "
                          "a byte-flip corruption leg in a temp dir (single "
                          "process, virtual CPU devices)")
+    ap.add_argument("--control-smoke", action="store_true",
+                    help="fleet-control acceptance drill (docs/observability"
+                         ".md 'Fleet control'): a halt alert on ONE "
+                         "simulated host's non-replicated metric stops all "
+                         "hosts at the same step with a drained emergency "
+                         "save, and a hung boundary sync exits the process "
+                         "with the tagged EXIT_HANG_ESCAPE code before "
+                         "resuming cleanly")
+    ap.add_argument("--hang-child", default=None, metavar="CFG_JSON",
+                    help=argparse.SUPPRESS)  # internal: the hang leg's
+    #                                          subprocess incarnation
     ap.add_argument("--corrupt", default=None, metavar="KIND",
                     help="run the corruption drill instead of the fault "
                          "drill: corrupt the completed run's newest "
@@ -528,6 +765,9 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    if args.hang_child is not None:
+        return _hang_child(args.hang_child, args.world, args.at_step)
+
     workdir = args.workdir
     if workdir is None:
         import tempfile
@@ -536,7 +776,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     record_path = None if args.no_record else os.path.normpath(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "..", LAST_DRILL_PATH))
     try:
-        if args.corrupt is not None:
+        if args.control_smoke:
+            # no --loss-tol here: every control-drill leg resumes at the
+            # SAME world size, so the continuity bar is bitwise
+            report = run_control_drill(
+                workdir, world=args.world, total_steps=args.steps,
+                save_every=args.save_every,
+            )
+        elif args.corrupt is not None:
             report = run_corruption_drill(
                 workdir, kind=args.corrupt,
                 world=args.world, resume_world=args.resume_world,
@@ -578,7 +825,16 @@ def main(argv: Optional[list[str]] = None) -> int:
 
             write_json({"ok": False, "error": str(e)}, args.json)
         return 1
-    if args.corrupt is not None:
+    if args.control_smoke:
+        logger.info(
+            "control drill OK: consensus stop at step %d on both simulated "
+            "hosts (sources %s), hang escape exited %d with bundle %s, "
+            "resumed to step %d bitwise (max |Δloss| %.1e)",
+            report["consensus_stop_step"], report["consensus_sources"],
+            report["hang_escape_code"], report["hang_bundle"],
+            report["total_steps"], report["max_loss_diff"],
+        )
+    elif args.corrupt is not None:
         logger.info(
             "corruption drill OK (%s): step %d corrupted -> quarantined, "
             "resumed %d -> %d devices from step %d (walked back %d); "

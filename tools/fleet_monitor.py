@@ -99,6 +99,40 @@ def resolve_input(path: str) -> tuple[str, str | None]:
     return "none", None
 
 
+def control_trail_section(run_dir: str | None) -> str:
+    """The fleet-control trail from the run dir's ``run_summary.json``
+    (trainer.control, docs/observability.md "Fleet control"): operator
+    commands with ack status and every consensus stop/checkpoint decision —
+    rendered next to the alert firings so the "why did the fleet stop"
+    answer sits beside the "which host was slow" one."""
+    if not run_dir:
+        return ""
+    try:
+        with open(os.path.join(run_dir, "run_summary.json")) as f:
+            summary = json.load(f)
+    except (OSError, ValueError):
+        return ""
+    parts: list[str] = []
+    alerts = summary.get("alerts") or []
+    if alerts:
+        lines = [f"alerts ({len(alerts)} firing"
+                 f"{'s' if len(alerts) != 1 else ''}):"]
+        for a in alerts:
+            if isinstance(a, dict):
+                lines.append(f"  step {str(a.get('step', '?')):<7} "
+                             f"action={str(a.get('action', '?')):<5} "
+                             f"[{a.get('rule', '?')}] {a.get('message', '')}")
+        parts.append("\n".join(lines))
+    ctl = summary.get("control")
+    if isinstance(ctl, dict) and ctl:
+        from _ctltrail import control_trail_lines
+
+        parts.append("\n".join(
+            ["fleet control (consensus decisions — docs/observability.md"
+             " 'Fleet control'):", *control_trail_lines(ctl)]))
+    return "\n\n".join(parts)
+
+
 def render(summary: dict) -> str:
     parts: list[str] = []
     n = summary.get("n_hosts", 0)
@@ -218,6 +252,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"wrote {out}", file=sys.stderr)
 
     print(render(summary))
+    # the control/alert trail lives in run_summary.json one level above the
+    # beacon dir (or in the run dir itself) — render it next to the fleet
+    # findings so stop decisions and straggler attribution read together
+    if os.path.isdir(args.path):
+        run_dir = (args.path if kind != "fleet_dir"
+                   or os.path.basename(resolved.rstrip("/")) != "fleet"
+                   else os.path.dirname(resolved.rstrip("/")) or ".")
+        trail = control_trail_section(run_dir)
+        if trail:
+            print()
+            print(trail)
     if args.json:
         write_json(summary, args.json)
     return 1 if summary.get("findings") else 0

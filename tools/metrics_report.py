@@ -27,6 +27,10 @@ import math
 import os
 import sys
 
+# sibling helpers (tools/_ctltrail.py): running as a script puts this dir
+# on sys.path already; a by-file-path spec load (the tests) does not
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 
 def load_metrics(path: str) -> list[dict]:
     records = []
@@ -302,6 +306,22 @@ def alerts_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def control_section(summary: dict) -> str:
+    """Fleet-control trail (trainer.control -> run_summary.json "control"):
+    operator commands received (with ack status), and every consensus
+    decision — the step it landed, the deciding condition, and the reason
+    (docs/observability.md "Fleet control").  The line formatter is shared
+    with ``tools/fleet_monitor.py`` (``tools/_ctltrail.py``)."""
+    ctl = summary.get("control")
+    if not isinstance(ctl, dict) or not ctl:
+        return ""
+    from _ctltrail import control_trail_lines
+
+    return "\n".join(["", "fleet control (consensus decisions — "
+                          "docs/observability.md 'Fleet control')",
+                      *control_trail_lines(ctl)])
+
+
 def fleet_section(run_dir: str | None) -> str:
     """Fleet plane summary (telemetry.fleet -> fleet_summary.json): host
     count, the modal straggler with its cause, quiet hosts, and the fleet
@@ -447,6 +467,7 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(integrity_section(summary))
         parts.append(anomalies_section(summary))
         parts.append(alerts_section(summary))
+        parts.append(control_section(summary))
         parts.append(census_section(summary))
         parts.append(provenance_section(summary))
         parts.append(perf_contract_section(summary))

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Enqueue operator commands for a RUNNING training job (the fleet control
+plane's command channel, docs/observability.md "Fleet control").
+
+Appends one JSON line to ``<run_dir>/control/commands.jsonl``; rank 0 polls
+the file at every logging boundary, folds the command into the consensus
+control word, and records parse/dedupe/ack as the ``control`` trail in
+``run_summary.json`` — so every host acts on the command at the SAME step:
+
+    python tools/run_ctl.py <run_dir> checkpoint_now   # save at next boundary
+    python tools/run_ctl.py <run_dir> stop             # graceful fleet stop
+                                                       # (emergency save)
+    python tools/run_ctl.py <run_dir> dump             # forensic bundle
+    python tools/run_ctl.py <run_dir> list             # queue + ack status
+    python tools/run_ctl.py <run_dir> stop --json -    # last line = JSON
+
+``<run_dir>`` is the experiment version dir (the one holding
+``run_summary.json`` / ``metrics.jsonl``).  Requires
+``exp_manager.telemetry.control.enabled: true`` on the run — ``list`` warns
+when the trail shows no evidence of a polling run.
+
+Stdlib-only: ``trainer/control.py`` is loaded by file path (the
+``tools/fleet_monitor.py`` posture), so this runs on a login node with
+nothing installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+from _jsonout import write_json  # noqa: E402
+
+
+def _load_control_module():
+    """``trainer/control.py`` by file path — stdlib-only by design, so the
+    package (and jax) never has to be importable here."""
+    path = (Path(__file__).resolve().parent.parent
+            / "neuronx_distributed_training_tpu" / "trainer" / "control.py")
+    spec = importlib.util.spec_from_file_location("_nxdt_control", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules[module]:
+    # register BEFORE exec or every @dataclass in the file blows up
+    sys.modules["_nxdt_control"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_trail(run_dir: str) -> dict:
+    path = os.path.join(run_dir, "run_summary.json")
+    try:
+        with open(path) as f:
+            return dict(json.load(f).get("control") or {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _render_list(ctl, run_dir: str) -> dict:
+    """Queue + ack status: every enqueued command, joined against the acks
+    the run recorded in ``run_summary.json``'s control trail."""
+    trail = _read_trail(run_dir)
+    acks = {a.get("id"): a for a in trail.get("commands") or []
+            if isinstance(a, dict)}
+    queued: list[dict] = []
+    path = ctl.commands_path(run_dir)
+    if path.exists():
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                queued.append({"malformed": line[:120]})
+                continue
+            if isinstance(rec, dict):
+                ack = acks.get(rec.get("id"))
+                rec["status"] = (ack or {}).get("status", "pending")
+                if ack and ack.get("step") is not None:
+                    rec["acked_step"] = ack["step"]
+                queued.append(rec)
+    out = {
+        "run_dir": str(run_dir),
+        "commands": queued,
+        "decisions": trail.get("decisions") or [],
+        "polling": bool(trail),
+    }
+    print(f"run_ctl: {len(queued)} command(s) in {path}")
+    for rec in queued:
+        if "malformed" in rec:
+            print(f"  (malformed line: {rec['malformed']})")
+            continue
+        step = (f" @ step {rec['acked_step']}" if "acked_step" in rec else "")
+        print(f"  {rec.get('id', '?'):<12} {rec.get('command', '?'):<15} "
+              f"{rec.get('status')}{step}"
+              + (f"  ({rec['note']})" if rec.get("note") else ""))
+    for d in (trail.get("decisions") or [])[-5:]:
+        conds = ",".join(d.get("conditions") or [])
+        print(f"  decision @ step {d.get('step')}: [{conds}] "
+              f"{d.get('reason', '')}")
+    if not trail:
+        print("run_ctl: no control trail in run_summary.json yet — is "
+              "exp_manager.telemetry.control.enabled on (and the run "
+              "past its first boundary)?", file=sys.stderr)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="experiment version dir (holds "
+                                    "run_summary.json / metrics.jsonl)")
+    ap.add_argument("command",
+                    choices=["stop", "checkpoint_now", "dump", "list"],
+                    help="operator command to enqueue (or 'list' to show "
+                         "the queue + ack status)")
+    ap.add_argument("--note", default=None,
+                    help="free-text note recorded with the command (shows "
+                         "up in the stop reason / ack trail)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the result as JSON ('-' = stdout, last "
+                         "line, the shared tools/_jsonout contract)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        print(f"run_ctl: no such run dir {args.run_dir}", file=sys.stderr)
+        return 2
+    ctl = _load_control_module()
+
+    if args.command == "list":
+        out = _render_list(ctl, args.run_dir)
+        if args.json:
+            write_json(out, args.json)
+        return 0
+
+    rec = ctl.append_command(args.run_dir, args.command, note=args.note)
+    print(f"run_ctl: enqueued {args.command} (id {rec['id']}) in "
+          f"{ctl.commands_path(args.run_dir)} — rank 0 folds it into the "
+          f"control word at the next logging boundary")
+    out = {"ok": True, "run_dir": str(args.run_dir), **rec}
+    if args.json:
+        write_json(out, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
