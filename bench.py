@@ -607,6 +607,32 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
                     f"exposed_collective_seconds="
                     f"{trace_summary.get('exposed_collective_seconds')}")
 
+    # measured peak HBM (telemetry.memory): the allocator's live watermark
+    # after the timed loop when the backend reports one, else the compiled
+    # memory_analysis() static estimate — the source is named so a reader
+    # never mistakes a static bound for a live measurement
+    peak_hbm_bytes = None
+    hbm_headroom_fraction = None
+    peak_hbm_source = None
+    try:
+        from neuronx_distributed_training_tpu.telemetry.memory import (
+            device_memory_samples, memory_metrics,
+        )
+
+        mm = memory_metrics(device_memory_samples([dev]))
+        peak_hbm_bytes = mm.get("memory/peak_bytes_max") \
+            or mm.get("memory/bytes_in_use_max")
+        hbm_headroom_fraction = mm.get("memory/hbm_headroom_fraction")
+        if peak_hbm_bytes is not None:
+            peak_hbm_source = "memory_stats"
+    except Exception as e:  # noqa: BLE001 — sampling must not fail the bench
+        log(f"bench: allocator sampling unavailable: {e}")
+    if peak_hbm_bytes is None:
+        ma = census.get("memory_analysis") or {}
+        if ma.get("peak_bytes"):
+            peak_hbm_bytes = float(ma["peak_bytes"])
+            peak_hbm_source = "memory_analysis"
+
     tokens_per_sec = mbs * seq / dt
     fwd_ft = perf.flops_for_config(cfg, seq)
     step_ft = perf.train_step_flops_per_token(fwd_ft)
@@ -625,6 +651,11 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
         "compile_seconds": round(compile_seconds, 2),
         "collectives": census.get("collectives"),
         "memory_analysis": census.get("memory_analysis"),
+        # measured memory (telemetry.memory / analysis.perf_contract PC501):
+        # worst-device peak bytes + remaining headroom fraction
+        "peak_hbm_bytes": json_float(peak_hbm_bytes, 1),
+        "hbm_headroom_fraction": json_float(hbm_headroom_fraction, 4),
+        "peak_hbm_source": peak_hbm_source,
         # numerics-health fields (telemetry.health): a throughput line from a
         # diverging run must be distinguishable from a healthy one
         "nonfinite_steps": nonfinite_steps,
@@ -722,6 +753,11 @@ def plan_topk_measure(dev, base_cfg, policy, precision_block, seq: int,
             r = run_bench(dev, cfg_i, policy, seq, mbs, steps, warmup,
                           num_microbatches=plan.num_microbatches)
             row["measured_ms"] = r["ms_per_step"]
+            # measured memory beside the residual record: the per-plan
+            # predicted-vs-measured HBM pair is a calibration point for the
+            # cost model's transient constants (telemetry.memory)
+            row["peak_hbm_bytes"] = r.get("peak_hbm_bytes")
+            row["hbm_headroom_fraction"] = r.get("hbm_headroom_fraction")
             predicted.append(cand.estimate.step_seconds * 1e3)
             measured.append(r["ms_per_step"])
             # per-term predicted-vs-measured residuals: the cost model
@@ -956,6 +992,12 @@ def main() -> None:
         "compile_seconds": r.get("compile_seconds"),
         "collectives": r.get("collectives"),
         "memory_analysis": r.get("memory_analysis"),
+        # measured memory (telemetry.memory; perf-contract PC501 gates the
+        # peak, PC502 the predicted-vs-measured agreement when a planner
+        # prediction rides along)
+        "peak_hbm_bytes": r.get("peak_hbm_bytes"),
+        "hbm_headroom_fraction": r.get("hbm_headroom_fraction"),
+        "peak_hbm_source": r.get("peak_hbm_source"),
         # numerics health (telemetry.health): fast-but-diverging vs healthy
         "nonfinite_steps": r.get("nonfinite_steps"),
         "skipped_updates": r.get("skipped_updates"),

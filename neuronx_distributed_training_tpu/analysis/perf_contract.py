@@ -21,8 +21,10 @@ silently eroding the recorded baselines.
   (PC101 step time, PC102 throughput/MFU, PC201 per-class achieved
   overlap, PC202 exposed collective seconds naming the collective class,
   PC301 measured bubble growth, PC302 measured-vs-predicted bubble outside
-  the calibration band, PC401 cost-model residual drift); improvements are
-  PC110 info findings the snapshot can tighten to.
+  the calibration band, PC401 cost-model residual drift, PC501 measured
+  peak-HBM growth, PC502 measured peak HBM beyond the planner's predicted
+  total x the calibration band — PC302/PC502 are baseline-independent);
+  improvements are PC110 info findings the snapshot can tighten to.
 - **the ratchet** — same workflow as graph contracts:
   ``tools/perf_contract.py --check`` fails on any error finding;
   ``--update-baselines`` commits improvements silently and refuses to
@@ -66,6 +68,12 @@ DEFAULT_NOISE: dict[str, float] = {
     "bubble_abs": 0.08,           # measured bubble-fraction growth; ALSO the
                                   # measured-vs-predicted calibration band
     "residual_frac": 0.30,        # cost-model total-residual drift
+    "peak_hbm_frac": 0.10,        # measured peak-HBM growth beyond this fails
+    "hbm_predicted_frac": 0.25,   # measured peak vs planner-predicted HBM:
+                                  # the calibration band PC502 gates on
+                                  # (baseline-independent; the analytic model
+                                  # documents +-15% agreement, this band adds
+                                  # runtime/fragmentation slack)
 }
 
 #: which subsystem a measured collective class's regression points at —
@@ -174,6 +182,9 @@ def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
             else pipe.get("bubble_fraction_measured")),
         "bubble_fraction_predicted": _num(
             payload.get("bubble_fraction_predicted")),
+        "peak_hbm_bytes": _num(payload.get("peak_hbm_bytes")),
+        "hbm_headroom_fraction": _num(payload.get("hbm_headroom_fraction")),
+        "predicted_hbm_bytes": _num(payload.get("predicted_hbm_bytes")),
         "residuals": payload.get("residuals")
         if isinstance(payload.get("residuals"), Mapping) else None,
     }
@@ -201,6 +212,9 @@ def perf_facts_from_trace_summary(summary: Mapping[str, Any]
         "bubble_fraction_measured": _num(pipe.get("bubble_fraction_measured")),
         "bubble_fraction_predicted": _num(
             pipe.get("bubble_fraction_predicted")),
+        "peak_hbm_bytes": None,
+        "hbm_headroom_fraction": None,
+        "predicted_hbm_bytes": None,
         "residuals": None,
     }
 
@@ -265,6 +279,37 @@ def perf_facts_from_run(run_dir: str | Path) -> dict[str, Any]:
     if facts.get("bubble_fraction_measured") is None:
         facts["bubble_fraction_measured"] = _num(
             run_summary.get("bubble_fraction_measured"))
+    # measured memory (telemetry.memory): the live allocator stream's
+    # worst-device watermark wins; the memory_summary.json profile is the
+    # fallback (per-device units either way — what PC501/PC502 compare)
+    facts["hbm_headroom_fraction"] = _num(
+        last_metrics.get("memory/hbm_headroom_fraction"))
+    peak = _num(last_metrics.get("memory/peak_hbm_bytes"))
+    predicted = None
+    try:
+        mem = json.loads((run_dir / "memory_summary.json").read_text())
+    except (OSError, ValueError):
+        mem = {}
+    if isinstance(mem, dict) and mem:
+        if peak is None:
+            peak = _num((mem.get("sampled") or {}).get("peak_hbm_bytes"))
+        if peak is None:
+            by_dev = (mem.get("profile") or {}).get("by_device") or {}
+            vals = [_num(v) for v in by_dev.values()]
+            vals = [v for v in vals if v]
+            if vals:
+                peak = max(vals)
+            else:
+                # the profile total spans ALL local devices — divide so
+                # PC501/PC502 stay in the per-device units the baselines
+                # and the planner's predicted total use
+                total = _num((mem.get("profile") or {}).get("total_bytes"))
+                n_dev = max(int((mem.get("profile") or {}).get(
+                    "num_devices", 1) or 1), 1)
+                peak = total / n_dev if total else None
+        predicted = _num((mem.get("predicted") or {}).get("total"))
+    facts["peak_hbm_bytes"] = peak
+    facts["predicted_hbm_bytes"] = predicted
     return facts
 
 
@@ -352,11 +397,34 @@ def _fmt(v: Optional[float], nd: int = 4) -> str:
 def calibration_findings(facts: Mapping[str, Any],
                          noise: Mapping[str, float],
                          report: AuditReport) -> None:
-    """PC302 — baseline-independent: the measured bubble fraction must stay
-    within the calibration band of the planner's prediction.  This is
-    ROADMAP item 1's success metric as a gate: a lockstep executor burning
-    the priced bubble (or a broken bubble price) fails here even on a
-    freshly baselined topology."""
+    """Baseline-independent gates: measured vs the planner's OWN prediction.
+
+    PC302 — the measured bubble fraction must stay within the calibration
+    band of the predicted fill/drain price (ROADMAP item 1's success metric
+    as a gate).  PC502 — the measured peak HBM must stay within the
+    calibration band of the planner's predicted per-device total: a
+    workload whose real residency outruns the HBM model's pricing fails
+    here even on a freshly baselined topology (the model's OOM pruning is
+    lying about this workload)."""
+    m_hbm = _num(facts.get("peak_hbm_bytes"))
+    p_hbm = _num(facts.get("predicted_hbm_bytes"))
+    if m_hbm is not None and p_hbm:
+        band = float(noise.get("hbm_predicted_frac",
+                               DEFAULT_NOISE["hbm_predicted_frac"]))
+        if m_hbm > p_hbm * (1.0 + band):
+            report.add(
+                "PC502", "error",
+                f"measured peak HBM {m_hbm / 1024**3:.3f}G exceeds the "
+                f"planner's predicted {p_hbm / 1024**3:.3f}G by more than "
+                f"the {100 * band:.0f}% calibration band "
+                f"({m_hbm / p_hbm:.2f}x)",
+                hint="the HBM model under-prices this workload — inspect "
+                     "memory_summary.json's attribution for the class "
+                     "carrying the excess, and recalibrate the transient "
+                     "constants with tools/plan.py --calibrate-from "
+                     "memory_summary.json (docs/observability.md 'Memory "
+                     "observability')",
+            )
     measured = _num(facts.get("bubble_fraction_measured"))
     predicted = _num(facts.get("bubble_fraction_predicted"))
     if measured is None or predicted is None:
@@ -565,7 +633,30 @@ def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
                 f"— tighten with --update-baselines",
             )
 
-    # -- PC302: measured vs predicted (baseline-independent) ---------------
+    # -- PC501: measured peak HBM ------------------------------------------
+    a = _num(old.get("peak_hbm_bytes"))
+    b = _num(new.get("peak_hbm_bytes"))
+    if a and b:
+        band = bands["peak_hbm_frac"]
+        if b > a * (1.0 + band):
+            report.add(
+                "PC501", "error",
+                f"measured peak HBM grew {a / 1024**3:.3f}G -> "
+                f"{b / 1024**3:.3f}G (+{100 * (b / a - 1):.0f}% > "
+                f"{100 * band:.0f}% noise band): this workload's live "
+                f"residency regressed",
+                hint="memory_summary.json's attribution names the subsystem "
+                     "that grew (params / opt state / activations / "
+                     "chunk-store / MoE workspace); " + _RATCHET_HINT,
+            )
+        elif b < a * (1.0 - band):
+            report.add(
+                "PC110", "info",
+                f"measured peak HBM improved {a / 1024**3:.3f}G -> "
+                f"{b / 1024**3:.3f}G — tighten with --update-baselines",
+            )
+
+    # -- PC302/PC502: measured vs predicted (baseline-independent) ---------
     calibration_findings(new, bands, report)
 
     # -- PC401: cost-model residual drift ----------------------------------
@@ -589,6 +680,7 @@ def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
     report.stats["step_time_ms"] = _num(new.get("step_time_ms"))
     report.stats["bubble_fraction_measured"] = _num(
         new.get("bubble_fraction_measured"))
+    report.stats["peak_hbm_bytes"] = _num(new.get("peak_hbm_bytes"))
     return report
 
 

@@ -34,10 +34,13 @@ pruning cannot drift from XLA reality.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+import logging
+from typing import Any, Mapping, Optional
 
 from neuronx_distributed_training_tpu.autotune.space import ModelFacts, Plan
 from neuronx_distributed_training_tpu.autotune.topology import ChipTopology
+
+logger = logging.getLogger(__name__)
 
 
 def _policy_for(facts: ModelFacts):
@@ -130,11 +133,19 @@ _PP_STAGE_BUFFERS = 5.3
 
 
 def hbm_breakdown(facts: ModelFacts, plan: Plan,
-                  policy: Any = None) -> dict[str, float]:
+                  policy: Any = None,
+                  calibration: Optional[Mapping[str, float]] = None
+                  ) -> dict[str, float]:
     """Per-device resident bytes by category.  ``total`` is what the planner
     budgets against (and what the calibration test compares to XLA's
     ``argument_size + temp_size``); the categories make PlanReports explain
-    themselves."""
+    themselves.
+
+    ``calibration`` maps category -> measured/prior ratio
+    (:func:`hbm_calibration_from_memory_summary`): each named category is
+    scaled by its MEASURED ratio before totalling, shrinking the documented
+    transient-constant blind spots on topologies a ``telemetry.memory``
+    capture has covered."""
     import jax.numpy as jnp
 
     policy = policy or _policy_for(facts)
@@ -231,6 +242,10 @@ def hbm_breakdown(facts: ModelFacts, plan: Plan,
         # (ops/moe.py weight-gather EP); the gathered copy is a transient
         comp = param_components(facts, plan)
         out["gathered_experts"] = comp["experts"] * plan.ep * abytes
+    if calibration:
+        for cat, ratio in calibration.items():
+            if cat in out:
+                out[cat] *= _clamp_ratio(ratio)
     out["total"] = sum(out.values())
     return out
 
@@ -238,6 +253,101 @@ def hbm_breakdown(facts: ModelFacts, plan: Plan,
 def estimate_hbm_bytes(facts: ModelFacts, plan: Plan,
                        policy: Any = None) -> float:
     return hbm_breakdown(facts, plan, policy)["total"]
+
+
+#: sanity clamp on measured/prior HBM calibration ratios — a degenerate
+#: measurement (empty profile, wrong units) must not zero a category out of
+#: the OOM pruning or blow it up 100x
+_HBM_RATIO_BOUNDS = (0.05, 20.0)
+
+
+def _clamp_ratio(v: Any) -> float:
+    lo, hi = _HBM_RATIO_BOUNDS
+    return min(max(float(v), lo), hi)
+
+
+def hbm_calibration_from_memory_summary(summary: Any) -> dict[str, float]:
+    """Measured/prior HBM ratios out of a ``memory_summary.json`` payload
+    (the dict, its file path, or a run dir containing it) — the memory
+    analogue of :func:`overlap_from_trace_summary`.
+
+    The summary carries the planner's PREDICTED per-device breakdown for
+    the resolved plan (written by the trainer at capture time); the
+    MEASURED side comes from the ONE shared join
+    (``telemetry.memory.measured_hbm_categories`` — exact tree bytes for
+    the state categories, profile attribution for the transients, the
+    worst-device allocator watermark for the total — everything in
+    per-device units).  Only categories with BOTH sides > 0 produce a
+    ratio — the calibration never pretends.  Raises ``ValueError`` when
+    the summary carries no usable pair (the planner turns that into a
+    report error)."""
+    from neuronx_distributed_training_tpu.telemetry.memory import (
+        load_memory_summary,
+        measured_hbm_categories,
+    )
+
+    summary = load_memory_summary(summary)
+    predicted = dict(summary.get("predicted") or {})
+    per_category, peak = measured_hbm_categories(summary)
+    out: dict[str, float] = {}
+    for cat, measured in per_category.items():
+        pred = predicted.get(cat)
+        if pred and measured > 0:
+            out[cat] = _clamp_ratio(measured / float(pred))
+    # the total ratio is the headline predicted-vs-actual audit number
+    # (reported, and what PC502 gates on)
+    if peak and predicted.get("total"):
+        out["total"] = _clamp_ratio(float(peak) / float(predicted["total"]))
+    if not out:
+        raise ValueError(
+            "memory summary carries no calibratable categories (no "
+            "predicted breakdown, or empty attribution) — nothing to "
+            "calibrate the HBM model from"
+        )
+    return out
+
+
+#: categories whose measured bytes come from the live-buffer profile — a
+#: BOUNDARY capture sees freed step transients as absent, so a small
+#: measured value proves nothing about the in-step peak.  Pricing treats
+#: their ratios as grow-only (a boundary capture can prove a term
+#: UNDER-priced — resident buffers the model didn't charge — but never
+#: over-priced); the state categories are exact tree bytes and move both
+#: ways.
+_TRANSIENT_CATEGORIES = frozenset(
+    {"activations", "pipeline_rings", "gathered_experts", "grads",
+     "logits", "batch"})
+
+
+def priced_hbm_calibration(cal: Mapping[str, float]) -> dict[str, float]:
+    """The PRICEABLE subset of a measured ratio set: ``total`` (the audit
+    headline) is dropped, and transient-category ratios floor at 1.0 —
+    conservative for OOM pruning (see :data:`_TRANSIENT_CATEGORIES`)."""
+    out: dict[str, float] = {}
+    for cat, ratio in cal.items():
+        if cat == "total":
+            continue
+        out[cat] = (max(float(ratio), 1.0)
+                    if cat in _TRANSIENT_CATEGORIES else float(ratio))
+    return out
+
+
+def predicted_breakdown_for_config(cfg: Mapping, chips: int
+                                   ) -> Optional[dict[str, float]]:
+    """The planner's per-device HBM breakdown for a LOADED config's declared
+    plan — what the trainer stamps into ``memory_summary.json`` and the OOM
+    bundle so predicted-vs-actual lives in one artifact.  ``None`` when the
+    config's degrees admit no resolved plan (never raises)."""
+    try:
+        facts = ModelFacts.from_config(cfg)
+        plan = facts.declared_plan_for(int(chips))
+        if plan is None:
+            return None
+        return {k: round(v, 1)
+                for k, v in hbm_breakdown(facts, plan).items()}
+    except Exception:  # noqa: BLE001 — the stamp is best-effort context
+        logger.debug("predicted HBM breakdown unavailable", exc_info=True)
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -383,12 +493,17 @@ class PlanEstimate:
 
 def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
                   *, hbm_headroom: float = 0.9,
-                  overlap: Any = None) -> PlanEstimate:
+                  overlap: Any = None,
+                  hbm_calibration: Optional[Mapping[str, float]] = None
+                  ) -> PlanEstimate:
     """Score one plan.  ``fits`` is False when the HBM estimate exceeds
     ``hbm_headroom`` x the topology's capacity (the runtime and fragmentation
     own the rest).  ``overlap`` — None (topology default), a fraction, or a
     per-axis mapping (:func:`overlap_from_trace_summary`) — sets how much of
-    each axis's collective wire time is priced as hidden under compute."""
+    each axis's collective wire time is priced as hidden under compute.
+    ``hbm_calibration`` — measured/prior ratios per HBM category
+    (:func:`hbm_calibration_from_memory_summary`) — reprices the memory
+    model with what a ``telemetry.memory`` capture actually observed."""
     from neuronx_distributed_training_tpu.utils.perf import (
         flops_breakdown_for_model,
     )
@@ -499,7 +614,7 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
         bubble = bubble_multiplier(
             plan.schedule, plan.pp, plan.num_microbatches, plan.vp) * inner
 
-    mem = hbm_breakdown(facts, plan, policy)
+    mem = hbm_breakdown(facts, plan, policy, calibration=hbm_calibration)
     fits = mem["total"] <= hbm_headroom * topo.hbm_bytes
     return PlanEstimate(
         compute_seconds=compute, comms_seconds=comms_total,

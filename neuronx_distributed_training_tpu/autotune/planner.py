@@ -34,7 +34,9 @@ from neuronx_distributed_training_tpu.autotune.cost_model import (
     PlanEstimate,
     estimate_hbm_bytes,
     estimate_plan,
+    hbm_calibration_from_memory_summary,
     overlap_from_trace_summary,
+    priced_hbm_calibration,
     resolve_overlap,
 )
 from neuronx_distributed_training_tpu.autotune.space import (
@@ -108,6 +110,11 @@ class PlanReport:
     #: the audit trail that keeps planner priors auditable, not trusted
     #: (analysis.perf_contract residuals; docs/observability.md)
     calibration_facts: Optional[dict] = None
+    #: measured/prior HBM ratios the ranking priced with (a
+    #: ``telemetry.memory`` capture via ``--calibrate-from
+    #: memory_summary.json``); ``total`` is the headline predicted-vs-
+    #: actual audit ratio — reported, not applied per-category
+    hbm_calibration: Optional[dict] = None
 
     @property
     def winner(self) -> Optional[PlanCandidate]:
@@ -130,6 +137,9 @@ class PlanReport:
                             for k, v in self.overlap.items()}
         if self.calibration_facts is not None:
             d["calibration_facts"] = self.calibration_facts
+        if self.hbm_calibration is not None:
+            d["hbm_calibration"] = {k: round(float(v), 4)
+                                    for k, v in self.hbm_calibration.items()}
         w = self.winner
         d["winner"] = dataclasses.asdict(w.plan) if w else None
         if self.error:
@@ -173,6 +183,12 @@ class PlanReport:
                 f"{k}={v:.2f}" for k, v in sorted(self.overlap.items())
                 if isinstance(v, float))
             lines.append(f"comms overlap ({src}): {axes}")
+        if self.hbm_calibration:
+            ratios = ", ".join(
+                f"{k}={float(v):.2f}"
+                for k, v in sorted(self.hbm_calibration.items()))
+            lines.append(
+                f"HBM calibration (measured/prior): {ratios}")
         cf = self.calibration_facts or {}
         if cf:
             bits = []
@@ -227,16 +243,20 @@ def rank_plans(
     hbm_headroom: float = 0.9,
     max_mbs: int = 8,
     overlap: Any = None,
+    hbm_calibration: Optional[Mapping[str, float]] = None,
 ) -> tuple[list[PlanCandidate], int, int]:
     """Enumerate + score the lattice.  Returns (ranked candidates, lattice
     size, fitting count).  Plans over the HBM budget rank strictly below
     every fitting plan (they are kept so a too-small topology still yields a
     ranked report instead of nothing).  ``overlap`` threads straight into
     :func:`~.cost_model.estimate_plan` — a measured calibration reprices
-    every plan's comms term and can reorder the ranking."""
+    every plan's comms term and can reorder the ranking; ``hbm_calibration``
+    (measured/prior ratios from a ``telemetry.memory`` capture) reprices
+    the memory model the same way."""
     plans = enumerate_plans(facts, chips, max_mbs=max_mbs)
     scored = [(p, estimate_plan(facts, p, topo, hbm_headroom=hbm_headroom,
-                                overlap=overlap))
+                                overlap=overlap,
+                                hbm_calibration=hbm_calibration))
               for p in plans]
     n_fit = sum(1 for _, e in scored if e.fits)
     scored.sort(key=lambda pe: (not pe[1].fits, pe[1].step_seconds)
@@ -347,11 +367,13 @@ def plan_config(
     smallest world its declared degrees need.  With ``audit=False`` the
     report is analytic-only (the ``--check`` gate's fast path).
 
-    ``calibration`` — a ``trace_summary.json`` path (or run dir, or its
-    loaded dict) from a ``telemetry.trace`` capture — replaces the topology
+    ``calibration`` — a ``trace_summary.json`` (``telemetry.trace``), a
+    ``memory_summary.json`` (``telemetry.memory``), a run dir holding
+    either/both, or a loaded dict of either — replaces the topology
     table's comms-overlap prior with the MEASURED per-collective-class
-    overlap, so predicted comms cost reflects what the scheduler actually
-    hid on this workload (``tools/plan.py --calibrate-from``)."""
+    overlap and/or the HBM model's transient constants with MEASURED
+    per-category ratios, so predicted cost reflects what this workload
+    actually did (``tools/plan.py --calibrate-from``)."""
     from neuronx_distributed_training_tpu.config.loader import load_config
 
     name = (Path(source).name if isinstance(source, (str, Path))
@@ -375,48 +397,73 @@ def plan_config(
     overlap = None
     measured = False
     calibration_facts: Optional[dict] = None
+    hbm_cal: Optional[dict] = None
     if calibration is not None:
-        from neuronx_distributed_training_tpu.telemetry.trace_analysis import (
-            load_trace_summary,
-        )
-
         try:
-            # load once: overlap_from_trace_summary accepts the loaded dict,
-            # and the calibration-facts audit below reads the same payload
-            summary = load_trace_summary(calibration)
-            overlap = overlap_from_trace_summary(summary)
-            measured = True
+            trace_doc, memory_doc = _resolve_calibration(calibration)
         except (OSError, ValueError) as e:
             return PlanReport(config=name, chips=chips, topology=topo.name,
                               candidates=[], n_plans=0, n_fit=0, facts=facts,
-                              error=f"overlap calibration failed: "
+                              error=f"calibration source failed to load: "
                                     f"{type(e).__name__}: {e}")
-        try:
-            # the calibration source's measured facts beyond overlap — the
-            # audit trail (exposed seconds, measured bubble) that lets the
-            # report show the priors AND what contradicts them
-            pipe = summary.get("pipeline") or {}
-            calibration_facts = {
-                k: v for k, v in {
-                    "achieved_overlap": summary.get("achieved_overlap"),
-                    "exposed_collective_seconds": summary.get(
-                        "exposed_collective_seconds"),
-                    "bubble_fraction_measured": pipe.get(
-                        "bubble_fraction_measured"),
-                    "schedule_measured": pipe.get("schedule"),
-                }.items() if v is not None
-            } or None
-        except Exception as e:  # noqa: BLE001 — the audit trail is advisory
-            logger.debug("calibration facts unavailable: %s", e)
+        if trace_doc is not None:
+            try:
+                overlap = overlap_from_trace_summary(trace_doc)
+                measured = True
+            except (OSError, ValueError) as e:
+                return PlanReport(
+                    config=name, chips=chips, topology=topo.name,
+                    candidates=[], n_plans=0, n_fit=0, facts=facts,
+                    error=f"overlap calibration failed: "
+                          f"{type(e).__name__}: {e}")
+            try:
+                # the calibration source's measured facts beyond overlap —
+                # the audit trail (exposed seconds, measured bubble) that
+                # lets the report show the priors AND what contradicts them
+                pipe = trace_doc.get("pipeline") or {}
+                calibration_facts = {
+                    k: v for k, v in {
+                        "achieved_overlap": trace_doc.get("achieved_overlap"),
+                        "exposed_collective_seconds": trace_doc.get(
+                            "exposed_collective_seconds"),
+                        "bubble_fraction_measured": pipe.get(
+                            "bubble_fraction_measured"),
+                        "schedule_measured": pipe.get("schedule"),
+                    }.items() if v is not None
+                } or None
+            except Exception as e:  # noqa: BLE001 — the trail is advisory
+                logger.debug("calibration facts unavailable: %s", e)
+        if memory_doc is not None:
+            try:
+                hbm_cal = hbm_calibration_from_memory_summary(memory_doc)
+            except (OSError, ValueError) as e:
+                return PlanReport(
+                    config=name, chips=chips, topology=topo.name,
+                    candidates=[], n_plans=0, n_fit=0, facts=facts,
+                    error=f"HBM calibration failed: "
+                          f"{type(e).__name__}: {e}")
+        if trace_doc is None and memory_doc is None:
+            return PlanReport(
+                config=name, chips=chips, topology=topo.name,
+                candidates=[], n_plans=0, n_fit=0, facts=facts,
+                error="calibration source carries neither a trace summary "
+                      "nor a memory summary — nothing to calibrate from")
     overlap_used = dict(resolve_overlap(overlap, topo), measured=measured)
+    # the report shows the RAW measured ratios; pricing uses the
+    # conservative subset — "total" is the audit headline (not a
+    # category), and transient-category ratios floor at 1.0 because a
+    # boundary capture cannot see freed step transients
+    # (cost_model.priced_hbm_calibration)
+    priced_cal = (priced_hbm_calibration(hbm_cal) if hbm_cal else None)
     ranked, n_plans, n_fit = rank_plans(
         facts, chips, topo, hbm_headroom=hbm_headroom, max_mbs=max_mbs,
-        overlap=overlap)
+        overlap=overlap, hbm_calibration=priced_cal or None)
     if not ranked:
         return PlanReport(config=name, chips=chips, topology=topo.name,
                           candidates=[], n_plans=0, n_fit=0, facts=facts,
                           overlap=overlap_used,
                           calibration_facts=calibration_facts,
+                          hbm_calibration=hbm_cal,
                           error="no legal plan for this chip count "
                                 "(check divisibility of heads/layers/batch)")
     if audit:
@@ -428,7 +475,8 @@ def plan_config(
     report = PlanReport(config=name, chips=chips, topology=topo.name,
                         candidates=candidates, n_plans=n_plans, n_fit=n_fit,
                         facts=facts, overlap=overlap_used,
-                        calibration_facts=calibration_facts)
+                        calibration_facts=calibration_facts,
+                        hbm_calibration=hbm_cal)
     w = report.winner
     if calibration_facts is not None and w is not None \
             and calibration_facts.get("bubble_fraction_measured") is not None \
@@ -442,6 +490,37 @@ def plan_config(
             float(calibration_facts["bubble_fraction_measured"]) - predicted,
             6)
     return report
+
+
+def _resolve_calibration(source: Any) -> tuple[Optional[dict],
+                                               Optional[dict]]:
+    """``--calibrate-from`` source -> ``(trace_doc, memory_doc)`` — either
+    may be None.  A run dir yields both when both summaries exist; a file
+    or loaded dict is classified by content (``telemetry.memory.
+    is_memory_summary``)."""
+    import json
+
+    from neuronx_distributed_training_tpu.telemetry.memory import (
+        is_memory_summary,
+    )
+
+    if isinstance(source, Mapping):
+        doc = dict(source)
+        return (None, doc) if is_memory_summary(doc) else (doc, None)
+    p = Path(source)
+    if p.is_dir():
+        trace_doc = memory_doc = None
+        tp = p / "trace_summary.json"
+        mp = p / "memory_summary.json"
+        if tp.exists():
+            trace_doc = json.loads(tp.read_text())
+        if mp.exists():
+            memory_doc = json.loads(mp.read_text())
+        return trace_doc, memory_doc
+    doc = json.loads(p.read_text())
+    if not isinstance(doc, dict):
+        raise ValueError(f"{p}: not a summary document")
+    return (None, doc) if is_memory_summary(doc) else (doc, None)
 
 
 def _first_device():

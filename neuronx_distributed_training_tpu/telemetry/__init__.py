@@ -43,6 +43,19 @@ from neuronx_distributed_training_tpu.telemetry.health import (
     HealthConfig,
     grad_group_of,
 )
+from neuronx_distributed_training_tpu.telemetry.memory import (
+    MEMORY_SUMMARY_NAME,
+    SUBSYSTEMS,
+    MemoryConfig,
+    MemoryPlane,
+    attribute_profile,
+    device_memory_samples,
+    is_oom_error,
+    load_memory_summary,
+    memory_metrics,
+    parse_memory_profile,
+    tree_bytes_by_subsystem,
+)
 from neuronx_distributed_training_tpu.telemetry.recompile import RecompileDetector
 from neuronx_distributed_training_tpu.telemetry.spans import (
     NON_PRODUCTIVE_SPANS,
@@ -74,7 +87,11 @@ __all__ = [
     "HangWatchdog",
     "HealthConfig",
     "HealthMonitor",
+    "MEMORY_SUMMARY_NAME",
+    "MemoryConfig",
+    "MemoryPlane",
     "NON_PRODUCTIVE_SPANS",
+    "SUBSYSTEMS",
     "RecompileDetector",
     "SpanTimer",
     "TELEMETRY_KNOBS",
@@ -84,11 +101,18 @@ __all__ = [
     "aggregate_fleet",
     "analyze_pipeline",
     "analyze_trace_dir",
+    "attribute_profile",
     "compile_census",
-    "parse_alerts",
+    "device_memory_samples",
     "grad_group_of",
+    "is_oom_error",
+    "load_memory_summary",
     "load_trace_summary",
     "memory_analysis_bytes",
+    "memory_metrics",
+    "parse_alerts",
+    "parse_memory_profile",
     "pipeline_facts",
     "trace_steps",
+    "tree_bytes_by_subsystem",
 ]

@@ -52,6 +52,7 @@ from neuronx_distributed_training_tpu.telemetry.alerts import (
 )
 from neuronx_distributed_training_tpu.telemetry.fleet import FleetConfig
 from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
+from neuronx_distributed_training_tpu.telemetry.memory import MemoryConfig
 from neuronx_distributed_training_tpu.telemetry.trace import TraceConfig
 from neuronx_distributed_training_tpu.trainer.control import ControlConfig
 
@@ -78,7 +79,7 @@ TELEMETRY_KNOBS: dict[str, bool] = {
 }
 
 #: nested (non-boolean) telemetry blocks, each validated by its own parser
-_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts", "control")
+_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts", "control", "memory")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +94,11 @@ class TelemetryConfig:
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    # live HBM attribution + OOM forensics (telemetry.memory):
+    # boundary-cadence allocator sampling across the mesh, the windowed
+    # device_memory_profile capture -> memory_summary.json, oom_<step>/
+    # forensic bundles (docs/observability.md "Memory observability")
+    memory: MemoryConfig = dataclasses.field(default_factory=MemoryConfig)
     alerts: tuple[AlertRule, ...] = ()
     # coordinated fleet control (trainer.control): consensus stop decisions
     # via the boundary control word + the operator command channel
@@ -139,6 +145,9 @@ class TelemetryConfig:
                 continue
             if k == "trace":
                 values[k] = TraceConfig.from_config(v)
+                continue
+            if k == "memory":
+                values[k] = MemoryConfig.from_config(v)
                 continue
             if k == "fleet":
                 values[k] = FleetConfig.from_config(v)

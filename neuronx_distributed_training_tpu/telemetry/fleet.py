@@ -65,8 +65,9 @@ logger = logging.getLogger(__name__)
 FLEET_DIR = "fleet"
 
 #: metric keys a beacon carries verbatim from the boundary fetch (plus every
-#: ``health/`` and ``data/`` key) — compact on purpose: beacons are appended
-#: every boundary for the life of the run
+#: ``health/``, ``data/``, and ``memory/`` key — the latter is the live HBM
+#: watermark/headroom stream, ``telemetry.memory``) — compact on purpose:
+#: beacons are appended every boundary for the life of the run
 BEACON_METRICS = (
     "loss", "step_time", "mfu", "tokens_per_sec_per_chip",
     "goodput_fraction", "throughput_seqs_per_sec",
@@ -195,7 +196,7 @@ class FleetBeacon:
         picked: dict[str, float] = {}
         for k, v in (metrics or {}).items():
             if k in BEACON_METRICS or k.startswith("health/") \
-                    or k.startswith("data/"):
+                    or k.startswith("data/") or k.startswith("memory/"):
                 try:
                     f = float(v)
                 except (TypeError, ValueError):
@@ -493,11 +494,19 @@ class FleetAggregator:
         hosts_block: dict[str, Any] = {}
         per_metric: dict[str, dict[int, float]] = {
             "mfu": {}, "goodput_fraction": {}, "data_wait_seconds": {},
-            "step_time": {},
+            "step_time": {}, "peak_hbm_bytes": {},
+            "hbm_headroom_fraction": {},
         }
         for h in sorted(self._hosts.values(), key=lambda s: s.host):
             last = h.last or {}
             data_wait = h.span(last, "data_wait") if last else 0.0
+            # live HBM watermark (telemetry.memory beacons first, the legacy
+            # device_memory key as fallback) — per-host memory spread is how
+            # a skewed-stage OOM-bound host shows up fleet-wide
+            peak_hbm = h.metric("memory/peak_hbm_bytes")
+            if peak_hbm is None:
+                peak_hbm = h.metric("device_peak_bytes_in_use")
+            headroom = h.metric("memory/hbm_headroom_fraction")
             hosts_block[str(h.host)] = {
                 "beacons": h.beacons,
                 "last_step": int(last.get("step", -1)),
@@ -510,12 +519,16 @@ class FleetAggregator:
                 "data_wait_seconds": round(data_wait, 6),
                 "device_peak_bytes_in_use": h.metric(
                     "device_peak_bytes_in_use"),
+                "peak_hbm_bytes": peak_hbm,
+                "hbm_headroom_fraction": headroom,
             }
             for key, getter in (
                 ("mfu", h.metric("mfu")),
                 ("goodput_fraction", h.metric("goodput_fraction")),
                 ("step_time", h.metric("step_time")),
                 ("data_wait_seconds", data_wait if last else None),
+                ("peak_hbm_bytes", peak_hbm),
+                ("hbm_headroom_fraction", headroom),
             ):
                 if getter is not None:
                     per_metric[key][h.host] = float(getter)

@@ -533,6 +533,15 @@ class SimulatedPreemption(RuntimeError):
     catches it where a real fleet would observe the process gone."""
 
 
+class SimulatedOOM(RuntimeError):
+    """Raised by :class:`FaultInjector` in ``oom`` mode — stands in for the
+    backend's allocator exhaustion (``XlaRuntimeError: RESOURCE_EXHAUSTED``)
+    escaping the step boundary.  The message carries the same
+    ``RESOURCE_EXHAUSTED`` marker the real error does, so
+    ``telemetry.memory.is_oom_error`` (and therefore the ``oom_<step>/``
+    forensic path) treats drill and reality identically."""
+
+
 @dataclasses.dataclass
 class FaultInjector:
     """Kills (or gracefully preempts, or hangs) a run at a configurable point.
@@ -559,10 +568,14 @@ class FaultInjector:
     flight_recorder.HangWatchdog` escape is drilled against: the watchdog
     must dump the ``hang_<step>/`` bundle, emit the dying beacon, and exit
     the process with ``EXIT_HANG_ESCAPE`` long before the sleep ends.
+    ``mode="oom"`` raises :class:`SimulatedOOM` (message carrying the real
+    backend's ``RESOURCE_EXHAUSTED`` marker) — the OOM-forensics drill:
+    the fit loop must dump a complete ``oom_<step>/`` bundle
+    (``telemetry.memory``) before the error propagates.
     """
 
     at_step: int
-    mode: str = "kill"          # kill | sigterm | hang
+    mode: str = "kill"          # kill | sigterm | hang | oom
     phase: str = "step"         # step | save | restore | sync
     fired: bool = False
     #: how long mode="hang" blocks; the watchdog is expected to escape the
@@ -571,9 +584,10 @@ class FaultInjector:
     hang_seconds: float = 60.0
 
     def __post_init__(self) -> None:
-        if self.mode not in ("kill", "sigterm", "hang"):
-            raise ValueError(f"FaultInjector.mode must be kill|sigterm|hang, "
-                             f"got {self.mode!r}")
+        if self.mode not in ("kill", "sigterm", "hang", "oom"):
+            raise ValueError(
+                f"FaultInjector.mode must be kill|sigterm|hang|oom, "
+                f"got {self.mode!r}")
         if self.phase not in ("step", "save", "restore", "sync"):
             raise ValueError(
                 f"FaultInjector.phase must be step|save|restore|sync, "
@@ -587,6 +601,10 @@ class FaultInjector:
         if self.mode == "kill":
             raise SimulatedPreemption(
                 f"injected {self.phase} kill at step {step}")
+        if self.mode == "oom":
+            raise SimulatedOOM(
+                f"RESOURCE_EXHAUSTED: injected allocator exhaustion at "
+                f"step {step} (drill stand-in for the backend's OOM)")
         if self.mode == "hang":
             logger.warning("injected %s hang at step %d (%.0fs — the "
                            "watchdog should escape first)", self.phase, step,

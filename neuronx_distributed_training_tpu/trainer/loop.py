@@ -78,24 +78,53 @@ def parse_max_time(value: Any) -> Optional[float]:
     return float(((d * 24 + h) * 60 + m) * 60 + s)
 
 
-def _device_memory_metrics(mesh) -> dict[str, float]:
-    """Live allocator stats of the first mesh device (telemetry.device_memory).
+def _local_mesh_devices(mesh) -> list:
+    """This process's devices of the mesh (every device single-host)."""
+    devices = list(getattr(mesh, "local_devices", None) or mesh.devices.flat)
+    if not devices:
+        devices = list(mesh.devices.flat)
+    return devices
 
-    ``memory_stats()`` is a local allocator query — no device sync — but some
-    backends (CPU, older plugins) don't implement it; those log nothing."""
-    try:
-        stats = mesh.devices.flat[0].memory_stats() or {}
-    except Exception:  # noqa: BLE001 — optional observability
-        return {}
-    out: dict[str, float] = {}
-    for src, dst in (
-        ("bytes_in_use", "device_bytes_in_use"),
-        ("peak_bytes_in_use", "device_peak_bytes_in_use"),
-        ("bytes_limit", "device_bytes_limit"),
-    ):
-        if src in stats:
-            out[dst] = float(stats[src])
-    return out
+
+#: ``memory/`` metric -> its legacy ``device_*`` key (telemetry.
+#: device_memory predates the memory plane; beacons and dashboards key on
+#: these names)
+_LEGACY_DEVICE_MEMORY_KEYS = (
+    ("memory/bytes_in_use_max", "device_bytes_in_use"),
+    ("memory/peak_bytes_max", "device_peak_bytes_in_use"),
+    ("memory/bytes_limit_min", "device_bytes_limit"),
+    ("memory/bytes_in_use_min", "device_bytes_in_use_min"),
+    ("memory/bytes_in_use_p50", "device_bytes_in_use_p50"),
+    ("memory/peak_device", "device_peak_device"),
+)
+
+
+def _legacy_device_memory_keys(mm: dict[str, float]) -> dict[str, float]:
+    """``memory/`` metrics -> the legacy ``device_*`` names, so a boundary
+    with BOTH ``device_memory`` and ``telemetry.memory`` on runs ONE
+    allocator sweep (the two keys would otherwise come from two sweeps at
+    slightly different instants and disagree within one record)."""
+    return {dst: mm[src] for src, dst in _LEGACY_DEVICE_MEMORY_KEYS
+            if src in mm}
+
+
+def _device_memory_metrics(mesh) -> dict[str, float]:
+    """Live allocator stats across ALL local mesh devices
+    (telemetry.device_memory).
+
+    ``memory_stats()`` is a local allocator query — no device sync — but
+    some backends (CPU, older plugins) don't implement it; those log
+    nothing.  The legacy ``device_*`` keys carry the WORST device (max
+    in-use/peak, min limit) with min/p50 spread alongside and the peak
+    device named by index — a skewed-stage pp run must not hide an
+    OOM-bound device behind a roomy rank 0."""
+    from neuronx_distributed_training_tpu.telemetry.memory import (
+        device_memory_samples,
+        memory_metrics,
+    )
+
+    samples = device_memory_samples(_local_mesh_devices(mesh))
+    return _legacy_device_memory_keys(memory_metrics(samples))
 
 
 def _sidecar_load(path, tag):
@@ -1213,6 +1242,39 @@ class Trainer:
 
             alerts = AlertEngine(
                 tel.alerts, write_run_summary=self.exp.write_run_summary)
+        # -- memory observability (telemetry.memory — docs/observability.md
+        # "Memory observability"): per-device allocator stats across the
+        # local mesh at every boundary (memory/ metrics through all sinks +
+        # fleet beacons), ONE windowed device_memory_profile() capture
+        # attributed to subsystems -> memory_summary.json, and OOM
+        # forensics (a RESOURCE_EXHAUSTED escaping the step boundary dumps
+        # oom_<step>/ with predicted-vs-actual in one artifact).  Host-side
+        # only: zero graph changes, zero extra syncs between boundaries.
+        memplane = None
+        if tel.memory.enabled:
+            try:
+                from neuronx_distributed_training_tpu.autotune.cost_model import (  # noqa: E501
+                    predicted_breakdown_for_config,
+                )
+                from neuronx_distributed_training_tpu.telemetry import (
+                    MemoryPlane,
+                )
+                from neuronx_distributed_training_tpu.telemetry.memory import (  # noqa: E501
+                    tree_bytes_by_subsystem,
+                )
+
+                memplane = MemoryPlane(
+                    tel.memory, self.exp.log_dir,
+                    devices=lambda: _local_mesh_devices(self.mesh),
+                    tree_bytes_fn=lambda: tree_bytes_by_subsystem(
+                        self.params, self.opt_state),
+                    predicted=predicted_breakdown_for_config(
+                        self.cfg, int(self.mesh.devices.size)),
+                    run_facts=self.run_facts,
+                    write_run_summary=self.exp.write_run_summary,
+                )
+            except Exception as e:  # noqa: BLE001 — observability must not
+                logger.warning("memory plane unavailable: %s", e)
         # -- coordinated fleet control (trainer.control — docs/observability
         # .md "Fleet control"): every stop/checkpoint decision folds through
         # ONE tiny replicated collective at the deterministic boundary
@@ -1605,7 +1667,20 @@ class Trainer:
                     if tel.goodput:
                         last_metrics["goodput_fraction"] = (
                             spans.goodput_fraction())
-                    if tel.device_memory:
+                    if memplane is not None:
+                        # memory/ metrics (worst-device in-use/peak/headroom
+                        # + spread) ride the same boundary record into every
+                        # sink, the fleet beacon, and the alert rules; the
+                        # in-window boundary additionally captures the
+                        # memory profile -> memory_summary.json.  With
+                        # device_memory ALSO on, the legacy device_* keys
+                        # derive from this same sweep — never a second one.
+                        mem_metrics = memplane.boundary(self.step)
+                        last_metrics.update(mem_metrics)
+                        if tel.device_memory:
+                            last_metrics.update(
+                                _legacy_device_memory_keys(mem_metrics))
+                    elif tel.device_memory:
                         last_metrics.update(_device_memory_metrics(self.mesh))
                     if batch_stats is not None and self.step % log_every == 0:
                         # data/ stats the prefetch thread accumulated since
@@ -1765,8 +1840,25 @@ class Trainer:
                     self.preemption_notice = None
         except BaseException as e:
             fit_exc = e
+            if memplane is not None:
+                # OOM forensics (telemetry.memory): a RESOURCE_EXHAUSTED
+                # escaping the step boundary dumps the oom_<step>/ bundle —
+                # last allocator samples, live-buffer attribution, the
+                # census's memory_analysis bytes, and the planner's
+                # predicted breakdown — before the exception propagates.
+                # dump_oom never raises.
+                from neuronx_distributed_training_tpu.telemetry.memory import (  # noqa: E501
+                    is_oom_error,
+                )
+
+                if is_oom_error(e):
+                    memplane.dump_oom(
+                        self.step, e, boundary_metrics=last_metrics,
+                        memory_analysis=self._census_memory_analysis())
             raise
         finally:
+            if memplane is not None:
+                memplane.close()
             if fleet is not None:
                 # final beacon FIRST (before the checkpoint drain can block):
                 # clean exit -> closing:true, a raising fit() -> the
@@ -1871,6 +1963,20 @@ class Trainer:
         if disc.get("legacy_restore") or own.get("legacy_restore"):
             merged["legacy_restore"] = True
         return merged
+
+    def _census_memory_analysis(self) -> Optional[dict]:
+        """The compile census's ``memory_analysis`` bytes out of
+        ``run_summary.json`` (for the OOM bundle's predicted-vs-actual);
+        None when the census didn't run or the file is unreadable."""
+        import json as _json
+        from pathlib import Path
+
+        try:
+            with open(Path(self.exp.log_dir) / "run_summary.json") as f:
+                ma = _json.load(f).get("memory_analysis")
+            return dict(ma) if isinstance(ma, dict) else None
+        except (OSError, ValueError, AttributeError, TypeError):
+            return None
 
     def _compile_census(self, batch, key, spans) -> None:
         """First-compile census (telemetry.compile_census): AOT lower+compile

@@ -188,6 +188,23 @@ def render(summary: dict) -> str:
         parts.append("per-host spread:")
         parts.append(_table(rows, ("metric", "min", "p50", "max")))
 
+    hosts_mem = [(hid, h) for hid, h in sorted(
+        (summary.get("hosts") or {}).items(), key=lambda kv: int(kv[0]))
+        if h.get("peak_hbm_bytes") is not None
+        or h.get("hbm_headroom_fraction") is not None]
+    if hosts_mem:
+        rows = []
+        for hid, h in hosts_mem:
+            peak = h.get("peak_hbm_bytes")
+            head = h.get("hbm_headroom_fraction")
+            rows.append((hid,
+                         f"{peak / 1024**3:.3f}G" if peak else "-",
+                         f"{100 * head:.1f}%" if head is not None else "-"))
+        parts.append("per-host memory (telemetry.memory beacons — worst "
+                     "device watermark + remaining headroom; "
+                     "tools/memory_report.py renders the attribution):")
+        parts.append(_table(rows, ("host", "peak_hbm", "headroom")))
+
     gp = summary.get("goodput")
     if gp:
         parts.append(

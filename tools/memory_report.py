@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Render memory observability artifacts as terminal tables.
+
+The reader for everything ``telemetry.memory`` writes
+(docs/observability.md "Memory observability"):
+
+    python tools/memory_report.py nxdt_experiments/run/version_0   # run dir
+    python tools/memory_report.py path/to/memory_summary.json
+    python tools/memory_report.py path/to/oom_00000042             # OOM bundle
+    python tools/memory_report.py capture.pprof                    # raw profile
+    python tools/memory_report.py run_dir --json -                 # last line
+                                                                   # = JSON
+
+Shows the live-buffer attribution table (per subsystem, with the honest
+``unattributed`` remainder), the exact tree bytes of the state subsystems,
+per-device spread, headroom, and — when the summary carries the planner's
+predicted breakdown — the predicted-vs-measured table the
+``plan.py --calibrate-from`` ratios come from.  An OOM bundle renders its
+attribution-at-death and the allocator-sample ring.
+
+Stdlib-only: a raw ``.pprof`` input loads ``telemetry/memory.py`` by file
+path (its parser is deliberately dependency-free), so this runs on a login
+node with nothing installed — the ``metrics_report``/``fleet_monitor``
+posture.  ``--json`` keeps the shared ``tools/_jsonout.py``
+single-last-line contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # tools/_jsonout
+
+from _jsonout import write_json  # noqa: E402
+
+
+def _load_memory_module():
+    """``telemetry/memory.py`` by file path — stdlib-only at import by
+    design, so the package (and jax) never has to be importable here."""
+    path = (Path(__file__).resolve().parent.parent
+            / "neuronx_distributed_training_tpu" / "telemetry" / "memory.py")
+    spec = importlib.util.spec_from_file_location("_nxdt_memory", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_nxdt_memory"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mb(v) -> str:
+    if v is None:
+        return "-"
+    try:
+        return f"{float(v) / 1024**2:,.2f}"
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _table(rows: list[tuple], header: tuple) -> str:
+    widths = [max(len(str(r[i])) for r in [header, *rows])
+              for i in range(len(header))]
+
+    def fmt_row(r):
+        return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt_row(header), sep, *(fmt_row(r) for r in rows)])
+
+
+def attribution_rows(attribution: dict, total) -> list[tuple]:
+    # render order comes from the plane itself (telemetry.memory.SUBSYSTEMS
+    # via the file-path load) — a class this tool's source predates must
+    # still get a full row, never a silent drop
+    order = tuple(_load_memory_module().SUBSYSTEMS)
+    rows = []
+    for cls in (*order, *(c for c in attribution if c not in order)):
+        rec = attribution.get(cls)
+        if rec is None:
+            continue
+        b = rec.get("bytes") if isinstance(rec, dict) else rec
+        c = rec.get("count") if isinstance(rec, dict) else None
+        pct = (f"{100 * float(b) / float(total):.1f}%"
+               if total and b is not None else "-")
+        rows.append((cls, _mb(b), pct, c if c is not None else "-"))
+    return rows
+
+
+def render_summary(summary: dict) -> str:
+    parts: list[str] = []
+    prof = summary.get("profile") or {}
+    total = prof.get("total_bytes")
+    parts.append(
+        f"memory summary (schema {summary.get('schema', '?')}): profile at "
+        f"step {summary.get('profiled_step', '?')} — "
+        f"{_mb(total)} MB live across "
+        f"{prof.get('num_devices', '?')} device(s), "
+        f"{prof.get('num_samples', '?')} allocation sites "
+        f"(docs/observability.md 'Memory observability')")
+
+    att = summary.get("attribution") or {}
+    if att:
+        parts.append("attribution (live bytes per subsystem; the total "
+                     "reconciles with the profile by construction):")
+        parts.append(_table(attribution_rows(att, total),
+                            ("subsystem", "MB", "share", "allocs")))
+
+    tree = summary.get("tree_bytes") or {}
+    if tree:
+        rows = [(k, _mb(v)) for k, v in sorted(tree.items())]
+        parts.append("exact tree bytes (host-side sharding metadata — the "
+                     "truth for state the profile's stacks can't see past "
+                     "donation):")
+        parts.append(_table(rows, ("subtree", "MB")))
+
+    by_dev = prof.get("by_device") or {}
+    if len(by_dev) > 1:
+        vals = sorted(by_dev.items(), key=lambda kv: -float(kv[1]))
+        rows = [(d, _mb(b)) for d, b in vals]
+        parts.append("per-device live bytes (spread — a skewed stage shows "
+                     "here):")
+        parts.append(_table(rows, ("device", "MB")))
+
+    sampled = summary.get("sampled") or {}
+    per_dev = sampled.get("per_device") or []
+    if per_dev:
+        rows = []
+        for s in per_dev:
+            limit = s.get("bytes_limit")
+            head = (f"{100 * (1 - s.get('bytes_in_use', 0) / limit):.1f}%"
+                    if limit else "-")
+            rows.append((s.get("device"), _mb(s.get("bytes_in_use")),
+                         _mb(s.get("peak_bytes_in_use")), _mb(limit), head))
+        parts.append("allocator samples (at capture):")
+        parts.append(_table(rows, ("device", "in_use_MB", "peak_MB",
+                                   "limit_MB", "headroom")))
+    if sampled.get("peak_hbm_bytes"):
+        parts.append(f"running peak HBM (worst device watermark): "
+                     f"{_mb(sampled['peak_hbm_bytes'])} MB")
+
+    predicted = summary.get("predicted") or {}
+    if predicted:
+        # THE shared measured-side join (telemetry.memory.
+        # measured_hbm_categories — file-path-loaded, stdlib-only): the
+        # table must show the very numbers plan.py --calibrate-from
+        # applies, not a hand-maintained copy of the map
+        mem = _load_memory_module()
+        measured_cat, peak = mem.measured_hbm_categories(summary)
+        rows = []
+        for cat in sorted(predicted):
+            if cat == "total":
+                continue
+            pred = predicted[cat]
+            meas = measured_cat.get(cat)
+            ratio = (f"{meas / pred:.2f}" if meas and pred else "-")
+            rows.append((cat, _mb(pred), _mb(meas), ratio))
+        ptot = predicted.get("total")
+        rows.append(("total (vs peak)", _mb(ptot), _mb(peak),
+                     f"{peak / ptot:.2f}" if peak and ptot else "-"))
+        parts.append("predicted vs measured, per device (the planner's HBM "
+                     "model audited — feed back with tools/plan.py "
+                     "--calibrate-from memory_summary.json):")
+        parts.append(_table(rows, ("category", "predicted_MB", "measured_MB",
+                                   "ratio")))
+    return "\n\n".join(parts)
+
+
+def render_oom(bundle: dict, ring: list) -> str:
+    parts = [f"OOM bundle: step {bundle.get('step', '?')} — "
+             f"{bundle.get('error', '')[:200]}"]
+    att = bundle.get("attribution_at_death") or bundle.get("attribution")
+    total = bundle.get("in_use_bytes_at_death")
+    if att:
+        parts.append("attribution at death:")
+        parts.append(_table(attribution_rows(att, total),
+                            ("subsystem", "MB", "share", "allocs")))
+    tree = bundle.get("tree_bytes") or {}
+    if tree:
+        parts.append(_table([(k, _mb(v)) for k, v in sorted(tree.items())],
+                            ("subtree", "MB")))
+    pred = bundle.get("predicted_hbm_breakdown") or {}
+    if pred:
+        rows = [(k, _mb(v)) for k, v in sorted(pred.items())]
+        parts.append("planner's predicted per-device breakdown (the "
+                     "predicted-vs-actual pair in one artifact):")
+        parts.append(_table(rows, ("category", "MB")))
+    ma = bundle.get("memory_analysis") or {}
+    if ma.get("peak_bytes"):
+        parts.append(f"compile-census memory_analysis peak: "
+                     f"{_mb(ma['peak_bytes'])} MB")
+    if bundle.get("peak_hbm_bytes"):
+        parts.append(f"sampled peak HBM before death: "
+                     f"{_mb(bundle['peak_hbm_bytes'])} MB")
+    if ring:
+        rows = []
+        for rec in ring[-8:]:
+            devs = rec.get("devices") or []
+            in_use = [d.get("bytes_in_use", 0) for d in devs]
+            rows.append((rec.get("step"), len(devs),
+                         _mb(max(in_use) if in_use else None)))
+        parts.append("last allocator samples (the ring):")
+        parts.append(_table(rows, ("step", "devices", "max_in_use_MB")))
+    return "\n\n".join(parts)
+
+
+def render_profile(profile: dict, attribution: dict) -> str:
+    total = profile.get("total_bytes")
+    parts = [f"raw memory profile: {_mb(total)} MB live, "
+             f"{len(profile.get('samples') or [])} allocation sites, "
+             f"{len(profile.get('by_device') or {})} device(s)"]
+    parts.append(_table(attribution_rows(attribution, total),
+                        ("subsystem", "MB", "share", "allocs")))
+    by_dev = profile.get("by_device") or {}
+    if by_dev:
+        parts.append(_table(sorted(((d, _mb(b)) for d, b in by_dev.items())),
+                            ("device", "MB")))
+    return "\n\n".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir, memory_summary.json, an "
+                                 "oom_<step>/ bundle dir, or a raw .pprof "
+                                 "capture")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the parsed payload as JSON ('-' = stdout, "
+                         "last line, the shared tools/_jsonout contract)")
+    args = ap.parse_args(argv)
+
+    path = Path(args.path)
+    payload: dict
+    if path.is_dir():
+        oom_json = path / "oom.json"
+        if oom_json.exists():
+            with open(oom_json) as f:
+                bundle = json.load(f)
+            ring = []
+            try:
+                with open(path / "samples.json") as f:
+                    ring = json.load(f)
+            except (OSError, ValueError):
+                pass
+            print(render_oom(bundle, ring))
+            payload = {"kind": "oom", **bundle, "ring_length": len(ring)}
+        else:
+            summary_path = path / "memory_summary.json"
+            if not summary_path.exists():
+                print(f"memory_report: no memory_summary.json or oom.json "
+                      f"under {path}", file=sys.stderr)
+                return 2
+            with open(summary_path) as f:
+                summary = json.load(f)
+            print(render_summary(summary))
+            payload = summary
+    elif path.suffix == ".json":
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("kind") == "oom":
+            print(render_oom(doc, []))
+        else:
+            print(render_summary(doc))
+        payload = doc
+    else:
+        # raw pprof capture: parse + attribute stdlib-only
+        mem = _load_memory_module()
+        data = path.read_bytes()
+        profile = mem.parse_memory_profile(data)
+        attribution = mem.attribute_profile(profile)
+        print(render_profile(profile, attribution))
+        payload = {
+            "kind": "profile",
+            "total_bytes": profile["total_bytes"],
+            "total_count": profile["total_count"],
+            "num_samples": len(profile["samples"]),
+            "by_device": profile["by_device"],
+            "attribution": attribution,
+        }
+    if args.json:
+        write_json(payload, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

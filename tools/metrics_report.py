@@ -322,6 +322,74 @@ def control_section(summary: dict) -> str:
                       *control_trail_lines(ctl)])
 
 
+def memory_section(summary: dict, run_dir: str | None) -> str:
+    """Memory observability (telemetry.memory -> run_summary.json "memory"
+    + memory_summary.json): live-buffer attribution per subsystem, peak
+    HBM, headroom, and the OOM trail when one fired — render the full
+    breakdown (per-device spread, predicted-vs-measured) with
+    ``tools/memory_report.py``."""
+    mem = summary.get("memory")
+    oom = summary.get("oom")
+    doc: dict = {}
+    if run_dir:
+        try:
+            with open(os.path.join(run_dir, "memory_summary.json")) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            pass
+    if not isinstance(mem, dict):
+        mem = {}
+    if not mem and not doc and not oom:
+        return ""
+    lines = ["", "memory (telemetry.memory — docs/observability.md "
+                 "'Memory observability'; tools/memory_report.py renders "
+                 "the full breakdown)"]
+    prof = doc.get("profile") or {}
+    in_use = mem.get("in_use_bytes") or prof.get("total_bytes")
+    if in_use is not None:
+        lines.append(f"  in_use_bytes          {_fmt_bytes(in_use)} "
+                     f"(profiled step "
+                     f"{mem.get('profiled_step', doc.get('profiled_step', '?'))})")
+    peak = mem.get("peak_hbm_bytes") or (doc.get("sampled")
+                                         or {}).get("peak_hbm_bytes")
+    if peak is not None:
+        lines.append(f"  peak_hbm_bytes        {_fmt_bytes(peak)} "
+                     f"(worst device watermark)")
+    pred = mem.get("predicted_hbm_bytes") or (doc.get("predicted")
+                                              or {}).get("total")
+    if pred and in_use:
+        n_dev = max(int(prof.get("num_devices", 1) or 1), 1)
+        lines.append(f"  predicted_hbm_bytes   {_fmt_bytes(pred)} per device "
+                     f"(measured/predicted "
+                     f"{float(peak or in_use / n_dev) / float(pred):.2f})")
+    att = doc.get("attribution") or {}
+    if not att and mem.get("attribution"):
+        att = {k: {"bytes": v} for k, v in mem["attribution"].items()
+               if v is not None}
+    if att:
+        total = prof.get("total_bytes") or sum(
+            (r.get("bytes") if isinstance(r, dict) else r) or 0
+            for r in att.values())
+        lines.append("  attribution (live bytes per subsystem):")
+        order = ("params", "opt_state", "master", "ema", "activations",
+                 "chunk_store", "moe_workspace", "batch", "executable",
+                 "unattributed")
+        # known order first, then any class this tool's list predates —
+        # the plane's "never silently dropped" contract holds here too
+        for cls in (*order, *(c for c in att if c not in order)):
+            rec = att.get(cls)
+            if rec is None:
+                continue
+            b = rec.get("bytes") if isinstance(rec, dict) else rec
+            share = (f"  ({100 * float(b) / float(total):.1f}%)"
+                     if total and b is not None else "")
+            lines.append(f"    {cls:<14} {_fmt_bytes(b or 0):>12}{share}")
+    if isinstance(oom, dict) and oom:
+        lines.append(f"  OOM at step {oom.get('step', '?')}: bundle "
+                     f"{oom.get('bundle', '?')} — {oom.get('error', '')}")
+    return "\n".join(lines)
+
+
 def fleet_section(run_dir: str | None) -> str:
     """Fleet plane summary (telemetry.fleet -> fleet_summary.json): host
     count, the modal straggler with its cause, quiet hosts, and the fleet
@@ -471,6 +539,7 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(census_section(summary))
         parts.append(provenance_section(summary))
         parts.append(perf_contract_section(summary))
+    parts.append(memory_section(summary, run_dir))
     parts.append(fleet_section(run_dir))
     parts.append(beacon_tail_section(run_dir))
     if trace_path and os.path.exists(trace_path):

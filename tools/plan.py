@@ -175,12 +175,15 @@ def main() -> None:
                     help="CI gate: declared parallelism must be in the "
                          "planner's top-3 meshes (or carry an "
                          "'# autotune-waiver:' comment)")
-    ap.add_argument("--calibrate-from", metavar="TRACE_SUMMARY",
-                    help="a trace_summary.json (or run dir holding one) "
-                         "from a telemetry.trace capture: price comms with "
-                         "the MEASURED per-collective-class overlap instead "
-                         "of the topology table's prior "
-                         "(docs/observability.md 'Device-time profiling')")
+    ap.add_argument("--calibrate-from", metavar="SUMMARY",
+                    help="a trace_summary.json (telemetry.trace), a "
+                         "memory_summary.json (telemetry.memory), or a run "
+                         "dir holding either/both: price comms with the "
+                         "MEASURED per-collective-class overlap and/or the "
+                         "HBM model with MEASURED per-subsystem ratios "
+                         "instead of the built-in priors "
+                         "(docs/observability.md 'Device-time profiling' / "
+                         "'Memory observability')")
     ap.add_argument("--apply", metavar="OUT_YAML",
                     help="write a copy of the (single) config with the "
                          "winning knobs imposed")
