@@ -74,6 +74,10 @@ DEFAULT_NOISE: dict[str, float] = {
                                   # (baseline-independent; the analytic model
                                   # documents +-15% agreement, this band adds
                                   # runtime/fragmentation slack)
+    "sweep_order_frac": 0.10,     # schedule-sweep ordering slack: PC303
+                                  # fails when interleaved measures slower
+                                  # than plain 1f1b beyond this fraction
+                                  # (the planner prices it at-or-below)
 }
 
 #: which subsystem a measured collective class's regression points at —
@@ -187,7 +191,29 @@ def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
         "predicted_hbm_bytes": _num(payload.get("predicted_hbm_bytes")),
         "residuals": payload.get("residuals")
         if isinstance(payload.get("residuals"), Mapping) else None,
+        "schedule_sweep": _sweep_rows(payload.get("schedule_sweep")),
     }
+
+
+def _sweep_rows(sweep: Any) -> Optional[list[dict[str, Any]]]:
+    """Normalize a ``bench.py --schedule-sweep`` block into canonical
+    per-schedule rows (None when the payload carries no sweep)."""
+    if not isinstance(sweep, Mapping):
+        return None
+    rows = []
+    for row in sweep.get("rows") or []:
+        if not isinstance(row, Mapping) or not row.get("schedule"):
+            continue
+        rows.append({
+            "schedule": str(row["schedule"]),
+            "step_time_ms": _num(row.get("ms_per_step")),
+            "bubble_fraction_measured": _num(
+                row.get("bubble_fraction_measured")),
+            "bubble_fraction_predicted": _num(
+                row.get("bubble_fraction_predicted")),
+            "bubble_residual": _num(row.get("bubble_residual")),
+        })
+    return rows or None
 
 
 def perf_facts_from_trace_summary(summary: Mapping[str, Any]
@@ -382,6 +408,10 @@ def default_key(facts: Mapping[str, Any]) -> str:
     while "__" in slug:
         slug = slug.replace("__", "_")
     src = str(w.get("source") or "bench")
+    if src == "bench" and w.get("metric") == "pipeline_schedule_sweep":
+        # the schedule sweep is its own workload: it must never be diffed
+        # against the single-chip headline baseline (PC001 would fire)
+        return f"{slug}_schedule_sweep"
     return f"{slug}_{src}" if src != "bench" else f"{slug}_bench"
 
 
@@ -425,6 +455,7 @@ def calibration_findings(facts: Mapping[str, Any],
                      "memory_summary.json (docs/observability.md 'Memory "
                      "observability')",
             )
+    _sweep_findings(facts, noise, report)
     measured = _num(facts.get("bubble_fraction_measured"))
     predicted = _num(facts.get("bubble_fraction_predicted"))
     if measured is None or predicted is None:
@@ -444,6 +475,61 @@ def calibration_findings(facts: Mapping[str, Any],
                  "straggler attribution, and parallel/pipeline.py "
                  "bubble_multiplier if the price itself is wrong",
         )
+
+
+def _sweep_findings(facts: Mapping[str, Any], noise: Mapping[str, float],
+                    report: AuditReport) -> None:
+    """Baseline-independent gates over ``bench.py --schedule-sweep`` rows.
+
+    Per row: PC302 — each schedule's measured bubble fraction must stay
+    within the calibration band of its own prediction.  Across rows:
+    PC303 — the measured wall-clock ordering must match the planner's
+    pricing: ``1f1b-interleaved`` at or below plain ``1f1b`` (within the
+    ``sweep_order_frac`` noise band).  The lockstep executor lost exactly
+    this gate (~1.25x at pp=2/nm=16/vp=2); the work-compacted executor is
+    what makes it green."""
+    rows = facts.get("schedule_sweep") or []
+    band = float(noise.get("bubble_abs", DEFAULT_NOISE["bubble_abs"]))
+    by_sched: dict[str, Mapping[str, Any]] = {}
+    for row in rows:
+        if not isinstance(row, Mapping):
+            continue
+        sched = str(row.get("schedule"))
+        by_sched[sched] = row
+        m = _num(row.get("bubble_fraction_measured"))
+        p = _num(row.get("bubble_fraction_predicted"))
+        if m is not None and p is not None and m > p + band:
+            report.add(
+                "PC302", "error",
+                f"[schedule sweep] {sched}: measured bubble fraction "
+                f"{_fmt(m)} exceeds its prediction {_fmt(p)} by more than "
+                f"the {_fmt(band)} calibration band",
+                location=sched,
+                hint="parallel/pipeline.py work_table prices this "
+                     "schedule's compacted execution — the executor is "
+                     "idling (or burning masked work) beyond it",
+            )
+    f1b = by_sched.get("1f1b")
+    il = by_sched.get("1f1b-interleaved")
+    if f1b and il:
+        a = _num(f1b.get("step_time_ms"))
+        b = _num(il.get("step_time_ms"))
+        oband = float(noise.get("sweep_order_frac",
+                                DEFAULT_NOISE["sweep_order_frac"]))
+        if a and b and b > a * (1.0 + oband):
+            report.add(
+                "PC303", "error",
+                f"[schedule sweep] measured ordering contradicts the "
+                f"planner's pricing: 1f1b-interleaved {_fmt(b, 2)}ms > "
+                f"plain 1f1b {_fmt(a, 2)}ms x (1 + {oband:g}) — the "
+                f"interleave's priced bubble win is not realized in "
+                f"wall-clock",
+                location="1f1b-interleaved",
+                hint="the work-compacted executor (parallel/pipeline.py "
+                     "_onef1b_body) is supposed to cash the interleave's "
+                     "fill/drain win — check the m-major work-table "
+                     "ordering and the per-kind cond gates",
+            )
 
 
 def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
@@ -505,6 +591,34 @@ def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
                 f"step time improved {_fmt(a, 2)}ms -> {_fmt(b, 2)}ms — "
                 f"tighten the baseline with --update-baselines",
             )
+
+    # -- PC101 per sweep row: schedule-sweep step times ---------------------
+    o_rows = {r.get("schedule"): r for r in old.get("schedule_sweep") or []
+              if isinstance(r, Mapping)}
+    n_rows = {r.get("schedule"): r for r in new.get("schedule_sweep") or []
+              if isinstance(r, Mapping)}
+    for sched in sorted(set(o_rows) & set(n_rows)):
+        a = _num(o_rows[sched].get("step_time_ms"))
+        b = _num(n_rows[sched].get("step_time_ms"))
+        if a and b:
+            band = bands["step_time_frac"]
+            if b > a * (1.0 + band):
+                report.add(
+                    "PC101", "error",
+                    f"[schedule sweep] {sched} step time grew "
+                    f"{_fmt(a, 2)}ms -> {_fmt(b, 2)}ms "
+                    f"(+{100 * (b / a - 1):.0f}% > {100 * band:.0f}% noise "
+                    f"band)",
+                    location=sched,
+                    hint=_RATCHET_HINT,
+                )
+            elif b < a * (1.0 - band):
+                report.add(
+                    "PC110", "info",
+                    f"[schedule sweep] {sched} step time improved "
+                    f"{_fmt(a, 2)}ms -> {_fmt(b, 2)}ms — tighten with "
+                    f"--update-baselines",
+                )
 
     # -- PC102: MFU / throughput -------------------------------------------
     a, b = _num(old.get("mfu")), _num(new.get("mfu"))

@@ -212,17 +212,28 @@ def hbm_breakdown(facts: ModelFacts, plan: Plan,
         else:
             in_flight = plan.num_microbatches * max(plan.vp, 1)
         act *= max(_PP_STAGE_BUFFERS, float(in_flight))
-        # stage-input-sized rings the NEW manual-vjp variants add on top of
-        # plain 1f1b's 2*pp slots (which the _PP_STAGE_BUFFERS calibration
-        # already absorbs): the interleave's [vp*nm] chunk-input store +
-        # two nm-slot circular hand-off stores, the zb split's pp-slot
-        # deferred-dy ring
+        # stage-input-sized rings the manual-vjp variants add on top of
+        # plain 1f1b (whose own buffering the _PP_STAGE_BUFFERS calibration
+        # already absorbs).  Priced from the work-compacted executor's
+        # ACTUAL interval-allocated ring sizes (pipeline.ring_slot_counts):
+        # the m-major interleave bounds the chunk-input store by the
+        # schedule's true in-flight window — O(pp*vp), independent of nm —
+        # instead of the old lockstep executor's O(vp*nm) store (the term
+        # that priced interleaved out of tight-HBM meshes at large nm).
         input_bytes = tokens_mb * (h / sp_div) * abytes
-        if plan.schedule == "1f1b-interleaved":
-            extra_slots = (plan.vp + 2) * plan.num_microbatches - 2 * plan.pp
+        if plan.schedule in ("1f1b-interleaved", "1f1b-zb"):
+            from neuronx_distributed_training_tpu.parallel.pipeline import (
+                ring_slot_counts,
+            )
+
+            vp = max(plan.vp, 1) if plan.schedule == "1f1b-interleaved" else 1
+            extra_slots = (
+                ring_slot_counts(plan.schedule, plan.pp,
+                                 plan.num_microbatches, vp)["total"]
+                - ring_slot_counts("1f1b", plan.pp,
+                                   plan.num_microbatches, 1)["total"]
+            )
             pipe_rings = max(extra_slots, 0) * input_bytes
-        elif plan.schedule == "1f1b-zb":
-            pipe_rings = plan.pp * input_bytes
 
     logits = _HEAD_BUFFERS * tokens_mb * facts.vocab / plan.tp * 4
     batch = (facts.global_batch_size / plan.dp) * facts.seq * 4 * 2

@@ -45,6 +45,7 @@ model code runs under any tp x dp combination.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Callable, Optional
 
@@ -419,15 +420,17 @@ def _pipeline_body(local_layers, microbatches, emb, *, stage_fn,
 # starts as soon as its forward leaves the last stage, bounding in-flight
 # activations to O(pp).
 #
-# ``pipeline_loss_and_grad`` is the TPU-native 1F1B: ONE ``lax.scan`` in which
-# rank ``r`` runs forward of microbatch ``m`` at tick ``m + r`` and backward of
-# ``m`` at tick ``m + 2*pp - 1 - r`` (the classic 1F1B steady state: one F and
-# one B per rank per tick).  Because JAX autodiff cannot interleave a scan's
-# backward into its forward, the backward is MANUAL: each B tick calls
+# ``pipeline_loss_and_grad`` is the TPU-native 1F1B: ONE ``lax.scan`` over a
+# WORK-COMPACTED schedule table (``work_table`` below — schedule as data): at
+# each compacted tick, rank ``r`` executes the table's (kind, microbatch,
+# chunk) entry for that tick, with the forward / head / backward / wgrad
+# blocks gated on tick-uniform ``lax.cond`` flags so a tick no rank forwards
+# (backwards) on costs nothing.  Because JAX autodiff cannot interleave a
+# scan's backward into its forward, the backward is MANUAL: each B tick calls
 # ``jax.vjp`` on the stage (recompute-and-backprop within the tick — the same
 # FLOPs as the wavefront's rematerialized backward), activation cotangents ride
 # the reverse ring, and parameter gradients accumulate in the scan carry.
-# Saved state is a 2*pp-slot ring buffer of stage inputs — the O(pp) class.
+# Saved state is an interval-allocated ring of stage inputs — the O(pp) class.
 #
 # The lm-head + CE cannot stay hoisted (its cotangent would be needed before
 # the forward scan ends), so it moves INSIDE the tick loop, sharded over
@@ -597,6 +600,352 @@ def resolve_schedule(schedule: str, model_cfg: Any, parallel_cfg: dict) -> str:
     return schedule
 
 
+# ---------------------------------------------------------------------------
+# Work-compacted schedule tables (schedule as data)
+# ---------------------------------------------------------------------------
+#
+# The manual-vjp executor used to be LOCKSTEP: one scan tick per global tick
+# of the classic algebra, every rank executing the full F + head + B (+W)
+# body every tick with `jnp.where` masks — a masked tick burned full compute,
+# so the priced bubble asymptotics never showed up in wall-clock (the
+# documented ~1.25x interleaved-vs-plain gap at pp=2/nm=16/vp=2).  The
+# executor below instead iterates over a PRECOMPUTED work table built host
+# side per schedule: a static ``[T, pp]`` array of (work_kind, microbatch,
+# chunk) entries.  Each scan tick gates its F / head / B / wgrad blocks on
+# tick-uniform table flags (``lax.cond`` whose predicate depends only on the
+# tick, so every device reaches every collective rendezvous together), which
+# compacts a kind's masked ticks out of the executed trip count: a tick no
+# rank forwards on costs no forward, a tick no rank backwards on costs no
+# backward.
+#
+# Orderings encoded in the table:
+# - plain ``1f1b``: microbatch order; B(m) may share the tick with the head
+#   that seeded it (the old dy_next carry cost one tick of latency).
+# - ``1f1b-interleaved``: depth-first **m-major pp-group** order (the
+#   Megatron interleave): microbatches advance in groups of ``pp`` through
+#   all ``vp`` chunks before the next group starts, and the backward walks
+#   the same groups with chunks descending.  F and B overlap like plain
+#   1F1B instead of serializing chunk-major, and a work item's stage input
+#   is consumed O(vp*pp) ticks after its save — the chunk-input store
+#   shrinks from O(vp*nm) to a ring bounded by the schedule's true
+#   in-flight window (``ring_slot_counts``; priced by
+#   ``autotune.cost_model``'s ``pipeline_rings`` term).
+# - ``1f1b-zb``: the dgrad tick parks dy and the wgrad for microbatch ``m``
+#   runs on EVERY rank at rank 0's dgrad tick (the table's rank-uniform
+#   fill) — wgrad ticks are fully dense, the park-ring re-linearization is
+#   table data rather than a fixed ``m + 2pp - 1`` slot.
+#
+# Every ring (stage-input store, forward/backward chunk hand-off, head-dy
+# park, zb deferred-dy park) is sized by interval allocation over the
+# table's actual write->last-read lifetimes — collision-free by
+# construction, asserted at build time.
+
+
+def _fwd_order(pp: int, nm: int, vp: int) -> list[tuple[int, int]]:
+    """Forward work order (chunk, microbatch), shared by every rank."""
+    if vp == 1:
+        return [(0, m) for m in range(nm)]
+    order = []
+    for g0 in range(0, nm, pp):
+        group = range(g0, min(g0 + pp, nm))
+        for c in range(vp):
+            order.extend((c, m) for m in group)
+    return order
+
+
+def _bwd_order(pp: int, nm: int, vp: int) -> list[tuple[int, int]]:
+    """Backward work order: same pp-groups, chunks descending."""
+    if vp == 1:
+        return [(0, m) for m in range(nm)]
+    order = []
+    for g0 in range(0, nm, pp):
+        group = range(g0, min(g0 + pp, nm))
+        for c in reversed(range(vp)):
+            order.extend((c, m) for m in group)
+    return order
+
+
+def _interval_alloc(items: list[tuple[int, int, Any]]
+                    ) -> tuple[dict, int]:
+    """Greedy register allocation over (write_tick, last_read_tick, key)
+    lifetimes -> ({key: slot}, n_slots).
+
+    A slot is reusable only for a write STRICTLY after its previous
+    occupant's last read: within one tick the executor's block order does
+    run writes before their same-tick reads, but the conservative rule
+    keeps every cross-value hazard impossible by construction."""
+    out: dict = {}
+    busy_until: list[int] = []  # slot -> last read tick of current occupant
+    for write, last_read, key in sorted(items, key=lambda it: (it[0], it[1])):
+        if last_read < write:
+            raise AssertionError(
+                f"work table bug: value {key} read at {last_read} before "
+                f"its write at {write}")
+        for s, until in enumerate(busy_until):
+            if until < write:
+                out[key] = s
+                busy_until[s] = last_read
+                break
+        else:
+            out[key] = len(busy_until)
+            busy_until.append(last_read)
+    return out, max(1, len(busy_until))
+
+
+#: per-tick work weights for the table-level bubble accounting: a forward
+#: costs ~1 unit, a full-vjp backward ~3 (recompute + dgrad + wgrad), a
+#: zb dgrad-only backward ~2, a deferred wgrad ~2 (re-linearize + dW) —
+#: the fwd+2xbwd convention split per pullback
+_WORK_UNITS = {"f": 1.0, "b_full": 3.0, "b_dgrad": 2.0, "w": 2.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkTable:
+    """Host-side compacted schedule for one manual-vjp variant.
+
+    ``rank_cols`` are ``[span, pp]`` arrays (one column per pipe rank, fed
+    to the executor pipe-sharded on dim 1); ``glob_cols`` are ``[span]``
+    tick-uniform arrays (collective gates and ring bookkeeping — identical
+    on every rank by construction, which is what makes the in-scan
+    ``lax.cond`` gates rendezvous-safe).  ``ring_sizes`` are the
+    interval-allocated slot counts per ring."""
+
+    schedule: str
+    pp: int
+    nm: int
+    vp: int
+    span: int
+    rank_cols: dict[str, np.ndarray]
+    glob_cols: dict[str, np.ndarray]
+    ring_sizes: dict[str, int]
+
+    @property
+    def lockstep_span(self) -> int:
+        """The old one-scan-tick-per-global-tick trip count, for reference."""
+        return (2 * self.vp - 1) * self.nm + 2 * self.pp - 1
+
+    def tick_counts(self) -> dict[str, int]:
+        g = self.glob_cols
+        return {
+            "span": self.span,
+            "f_ticks": int(g["has_f"].sum()),
+            "b_ticks": int(g["has_b"].sum()),
+            "w_ticks": int(g["has_w"].sum()),
+            "head_ticks": int(g["has_h"].sum()),
+            "lockstep_span": self.lockstep_span,
+        }
+
+    def bubble_fraction(self) -> float:
+        """Predicted idle fraction of the COMPACTED execution: the fraction
+        of executed work units that are masked fill/drain slots.  Weighted
+        by ``_WORK_UNITS`` — for ``1f1b`` and ``1f1b-interleaved`` the F and
+        B windows are equal-length and the weights cancel, reproducing the
+        closed-form ``b/(1+b)`` exactly (a tested invariant); for
+        ``1f1b-zb`` this is the HONEST SPMD number (the dense wgrad fill
+        cannot erase the dgrad chain's fill/drain the way the MPMD ZB-H1
+        asymptotic assumes)."""
+        wb = _WORK_UNITS["b_dgrad"] if self.schedule == "1f1b-zb" \
+            else _WORK_UNITS["b_full"]
+        g, r = self.glob_cols, self.rank_cols
+        per_tick = (_WORK_UNITS["f"] * g["has_f"]
+                    + wb * g["has_b"] + _WORK_UNITS["w"] * g["has_w"])
+        executed = self.pp * float(per_tick.sum())
+        useful = (_WORK_UNITS["f"] * float(r["f_valid"].sum())
+                  + wb * float(r["b_valid"].sum())
+                  + _WORK_UNITS["w"] * float(r["w_valid"].sum()))
+        return 1.0 - useful / executed if executed > 0 else 0.0
+
+
+@functools.lru_cache(maxsize=None)
+def work_table(schedule: str, pp: int, nm: int, vp: int = 1) -> WorkTable:
+    """Build the compacted work table for one manual-vjp schedule.
+
+    Per-rank F/B streams are exact one-tick shifts of rank 0's forward and
+    rank ``pp-1``'s backward streams (the ring-hop carries require the
+    producing rank's output to be consumed exactly one tick later); the
+    variable-latency hand-offs (chunk ring on rank 0, reverse chunk ring on
+    rank ``pp-1``, head-dy park, zb deferred-dy park) all ride
+    interval-allocated rings, so the streams themselves may compact freely."""
+    if schedule not in MANUAL_VJP_SCHEDULES:
+        raise ValueError(f"work_table: not a manual-vjp schedule: {schedule!r}")
+    if pp <= 1 or nm <= 0:
+        raise ValueError(f"work_table needs pp > 1 and nm > 0 (pp={pp}, nm={nm})")
+    vp = max(int(vp or 1), 1)
+    if (vp > 1) != (schedule == "1f1b-interleaved"):
+        raise ValueError(
+            f"work_table: schedule {schedule} is inconsistent with vp={vp}")
+    zb = schedule == "1f1b-zb"
+
+    # -- rank-0 forward stream (greedy ASAP, one F per tick) ---------------
+    t0F: dict[tuple[int, int], int] = {}
+    prev = -1
+    for c, m in _fwd_order(pp, nm, vp):
+        dep = t0F[(c - 1, m)] + pp if c > 0 else 0
+        prev = max(prev + 1, dep)
+        t0F[(c, m)] = prev
+    # head(m) shares the tick of the last rank's final-chunk forward
+    tH = {m: t0F[(vp - 1, m)] + pp - 1 for m in range(nm)}
+
+    # -- last-rank backward stream (greedy ASAP, one B per tick) -----------
+    tLB: dict[tuple[int, int], int] = {}
+    prev = -1
+    for c, m in _bwd_order(pp, nm, vp):
+        dep = tH[m] if c == vp - 1 else tLB[(c + 1, m)] + pp
+        prev = max(prev + 1, dep)
+        tLB[(c, m)] = prev
+    # zb deferred wgrad: rank-uniform at rank 0's dgrad tick — every rank
+    # has parked its dy by then, so wgrad ticks are fully dense (no rank
+    # burns a masked wgrad)
+    tW = {m: tLB[(0, m)] + pp - 1 for m in range(nm)} if zb else {}
+
+    span = 1 + max(
+        max(t for t in t0F.values()) + pp - 1,
+        max(t for t in tLB.values()) + pp - 1,
+        max(tW.values()) if tW else 0,
+    )
+
+    def ri(dtype=np.int32):
+        return np.zeros((span, pp), dtype)
+
+    def gi(dtype=np.int32):
+        return np.zeros((span,), dtype)
+
+    rank_cols = {
+        "f_m": ri(), "f_c": ri(), "f_valid": ri(bool), "f_slot": ri(),
+        "b_m": ri(), "b_c": ri(), "b_valid": ri(bool), "b_slot": ri(),
+        "w_m": ri(), "w_valid": ri(bool), "w_x_slot": ri(),
+        "bdy_slot": ri(), "w_dy_slot": ri(),
+    }
+    glob_cols = {
+        "has_f": gi(bool), "has_b": gi(bool), "has_w": gi(bool),
+        "has_h": gi(bool), "h_m": gi(),
+        "dyw_slot": gi(), "dyr_slot": gi(),
+        "feed_valid": gi(bool), "feed_src": gi(), "feed_slot": gi(),
+        "cpark_valid": gi(bool), "cpark_slot": gi(), "cread_slot": gi(),
+        "bpark_valid": gi(bool), "bpark_slot": gi(), "bread_slot": gi(),
+        "d0_valid": gi(bool), "d0_dst": gi(), "d0_slot": gi(),
+    }
+
+    for (c, m), t0 in t0F.items():
+        for r in range(pp):
+            t = t0 + r
+            rank_cols["f_m"][t, r] = m
+            rank_cols["f_c"][t, r] = c
+            rank_cols["f_valid"][t, r] = True
+        if c == 0:
+            glob_cols["feed_valid"][t0] = True
+            glob_cols["feed_src"][t0] = m % pp
+            glob_cols["feed_slot"][t0] = m // pp
+    for (c, m), tl in tLB.items():
+        for r in range(pp):
+            t = tl + (pp - 1 - r)
+            rank_cols["b_m"][t, r] = m
+            rank_cols["b_c"][t, r] = c
+            rank_cols["b_valid"][t, r] = True
+        if c == 0:
+            t0b = tl + pp - 1  # rank 0's dgrad tick
+            glob_cols["d0_valid"][t0b] = True
+            glob_cols["d0_dst"][t0b] = m % pp
+            glob_cols["d0_slot"][t0b] = m // pp
+    for m, t in tH.items():
+        glob_cols["has_h"][t] = True
+        glob_cols["h_m"][t] = m
+    for m, t in tW.items():
+        for r in range(pp):
+            rank_cols["w_m"][t, r] = m
+            rank_cols["w_valid"][t, r] = True
+    glob_cols["has_f"] = rank_cols["f_valid"].any(axis=1)
+    glob_cols["has_b"] = rank_cols["b_valid"].any(axis=1)
+    glob_cols["has_w"] = rank_cols["w_valid"].any(axis=1)
+
+    ring_sizes: dict[str, int] = {}
+
+    # stage-input store: write at the rank's F tick, last read at its B
+    # tick (and the rank-uniform wgrad tick under zb)
+    n_inflight = 1
+    for r in range(pp):
+        items = []
+        for (c, m), t0 in t0F.items():
+            write = t0 + r
+            last = tLB[(c, m)] + (pp - 1 - r)
+            if zb:
+                last = max(last, tW[m])
+            items.append((write, last, (c, m)))
+        alloc, n = _interval_alloc(items)
+        n_inflight = max(n_inflight, n)
+        for (c, m), s in alloc.items():
+            rank_cols["f_slot"][t0F[(c, m)] + r, r] = s
+            rank_cols["b_slot"][tLB[(c, m)] + (pp - 1 - r), r] = s
+            if zb:
+                rank_cols["w_x_slot"][tW[m], r] = s
+    ring_sizes["inflight"] = n_inflight
+
+    # forward chunk hand-off (rank 0): last rank's chunk-c output parks one
+    # tick after its F, read by rank 0's F of chunk c+1
+    if vp > 1:
+        items = [(t0F[(c, m)] + pp, t0F[(c + 1, m)], (c, m))
+                 for (c, m) in t0F if c < vp - 1]
+        alloc, n = _interval_alloc(items)
+        ring_sizes["circ"] = n
+        for (c, m), s in alloc.items():
+            glob_cols["cpark_valid"][t0F[(c, m)] + pp] = True
+            glob_cols["cpark_slot"][t0F[(c, m)] + pp] = s
+            glob_cols["cread_slot"][t0F[(c + 1, m)]] = s
+        # backward chunk hand-off (rank pp-1): rank 0's chunk-c dgrad parks
+        # one tick after its B, read by the last rank's B of chunk c-1
+        items = [(tLB[(c, m)] + pp, tLB[(c - 1, m)], (c, m))
+                 for (c, m) in tLB if c >= 1]
+        alloc, n = _interval_alloc(items)
+        ring_sizes["bcirc"] = n
+        for (c, m), s in alloc.items():
+            glob_cols["bpark_valid"][tLB[(c, m)] + pp] = True
+            glob_cols["bpark_slot"][tLB[(c, m)] + pp] = s
+            glob_cols["bread_slot"][tLB[(c - 1, m)]] = s
+    else:
+        ring_sizes["circ"] = ring_sizes["bcirc"] = 0
+
+    # head-dy park: written at the head tick, read by the last rank's
+    # final-chunk B (same tick legal: the head block precedes the backward
+    # block)
+    items = [(tH[m], tLB[(vp - 1, m)], m) for m in range(nm)]
+    alloc, n = _interval_alloc(items)
+    ring_sizes["dy"] = n
+    for m, s in alloc.items():
+        glob_cols["dyw_slot"][tH[m]] = s
+        glob_cols["dyr_slot"][tLB[(vp - 1, m)]] = s
+
+    # zb deferred-dy park: each rank parks dy at its dgrad tick, reads it
+    # at the rank-uniform wgrad tick
+    if zb:
+        n_wdy = 1
+        for r in range(pp):
+            items = [(tLB[(0, m)] + (pp - 1 - r), tW[m], m)
+                     for m in range(nm)]
+            alloc, n = _interval_alloc(items)
+            n_wdy = max(n_wdy, n)
+            for m, s in alloc.items():
+                rank_cols["bdy_slot"][tLB[(0, m)] + (pp - 1 - r), r] = s
+                rank_cols["w_dy_slot"][tW[m], r] = s
+        ring_sizes["wdy"] = n_wdy
+    else:
+        ring_sizes["wdy"] = 0
+
+    return WorkTable(schedule=schedule, pp=pp, nm=nm, vp=vp, span=span,
+                     rank_cols=rank_cols, glob_cols=glob_cols,
+                     ring_sizes=ring_sizes)
+
+
+def ring_slot_counts(schedule: str, pp: int, nm: int, vp: int = 1
+                     ) -> dict[str, int]:
+    """Stage-input-sized ring slots the compacted executor allocates for a
+    schedule — what ``autotune.cost_model`` prices as ``pipeline_rings``
+    (the delta over plain 1f1b, whose buffering the calibrated stage floor
+    already absorbs).  Includes a ``total``."""
+    sizes = dict(work_table(schedule, pp, nm, vp).ring_sizes)
+    sizes["total"] = sum(sizes.values())
+    return sizes
+
+
 def bubble_multiplier(schedule: Optional[str], pp: int, nm: int,
                       vp: int = 1) -> float:
     """Pipeline-bubble work multiplier: fill/drain time as a fraction of the
@@ -627,10 +976,28 @@ def bubble_multiplier(schedule: Optional[str], pp: int, nm: int,
 
 def predicted_bubble_fraction(schedule: Optional[str], pp: int, nm: int,
                               vp: int = 1) -> float:
-    """Predicted idle fraction of TOTAL pipelined step time,
-    ``b / (1 + b)`` for ``b = bubble_multiplier(...)`` — the telemetry
+    """Predicted idle fraction of TOTAL pipelined step time — the telemetry
     number (``run_summary.json`` / bench JSON ``bubble_fraction_predicted``);
-    0.0 when pp == 1."""
+    0.0 when pp == 1.
+
+    For the manual-vjp schedules this is derived from the COMPACTED work
+    table the executor actually runs (``WorkTable.bubble_fraction``): for
+    ``1f1b`` and ``1f1b-interleaved`` it equals the closed-form
+    ``b / (1 + b)`` exactly (the compacted table realizes the priced
+    asymptotics — a tested invariant), while ``1f1b-zb`` reports the honest
+    SPMD number (the dense wgrad fill cannot erase the dgrad chain's
+    fill/drain the way the MPMD ZB-H1 asymptotic assumes).  The autodiff
+    wavefront keeps the closed form."""
+    if pp <= 1 or nm <= 0:
+        return 0.0
+    if schedule in MANUAL_VJP_SCHEDULES:
+        # telemetry must not raise on an off-gate combo: normalize vp the
+        # way the executor's own dispatch does (interleaved is the only
+        # vp>1 schedule; a vp==1 "interleave" degenerates to plain 1f1b)
+        vp = max(int(vp or 1), 1) if schedule == "1f1b-interleaved" else 1
+        if schedule == "1f1b-interleaved" and vp == 1:
+            schedule = "1f1b"
+        return work_table(schedule, pp, nm, vp).bubble_fraction()
     b = bubble_multiplier(schedule, pp, nm, vp)
     return b / (1.0 + b)
 
@@ -751,10 +1118,19 @@ def pipeline_loss_and_grad(
 
     emb, emb_vjp = jax.vjp(emb_of, params)
 
+    # the compacted schedule as data: per-rank work entries ride into the
+    # manual region pipe-sharded on their rank dim, tick-uniform gate/ring
+    # columns replicated (see work_table)
+    schedule_name = ("1f1b-zb" if zero_bubble
+                     else ("1f1b-interleaved" if vp > 1 else "1f1b"))
+    table = work_table(schedule_name, pp, nm, vp)
+    wt_rank = {k: jnp.asarray(v) for k, v in table.rank_cols.items()}
+    wt_glob = {k: jnp.asarray(v) for k, v in table.glob_cols.items()}
+
     body = functools.partial(
         _onef1b_body,
         stage_fn=stage_fn, head_hidden_fn=head_hidden_fn, pp=pp, nm=nm,
-        vp=vp, zero_bubble=zero_bubble,
+        vp=vp, zero_bubble=zero_bubble, rings=table.ring_sizes,
         slots=slots, stage_aux=stage_aux, aux_scale=float(aux_scale),
         shift_labels=shift_labels, grad_dtype=grad_dtype,
         ignore_index=ignore_index,
@@ -764,13 +1140,15 @@ def pipeline_loss_and_grad(
     fn = shd.shard_map(
         body,
         mesh=mesh,
-        in_specs=(layer_spec, P(), P(), vocab_spec, P(PIPE_AXIS), P()),
+        in_specs=(layer_spec, P(), P(), vocab_spec, P(PIPE_AXIS), P(),
+                  P(None, PIPE_AXIS), P()),
         out_specs=(P(), layer_spec, P(PIPE_AXIS), vocab_spec, P(), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
     loss_sum, d_layers, d_emb, d_w, d_head_params, aux_total = fn(
-        layer_params, head_params, microbatches, head_weight, emb, denom
+        layer_params, head_params, microbatches, head_weight, emb, denom,
+        wt_rank, wt_glob,
     )
     loss = loss_sum / denom + aux_scale * aux_total
     (d_params_embed,) = emb_vjp(d_emb.astype(emb.dtype))
@@ -783,42 +1161,44 @@ def pipeline_loss_and_grad(
     return loss, grads
 
 
-def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
-                 stage_fn, head_hidden_fn, pp, nm, vp, zero_bubble, slots,
-                 stage_aux, aux_scale, shift_labels, grad_dtype, ignore_index):
-    """Per-pipe-rank manual-vjp tick loop (inside shard_map, manual "pipe").
+def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
+                 wt_rank, wt_glob, *,
+                 stage_fn, head_hidden_fn, pp, nm, vp, zero_bubble, rings,
+                 slots, stage_aux, aux_scale, shift_labels, grad_dtype,
+                 ignore_index):
+    """Per-pipe-rank WORK-COMPACTED manual-vjp tick loop (inside shard_map,
+    manual "pipe").
 
-    Tick algebra (rank ``r``, tick ``t``, work index ``w = c*nm + m`` over
-    chunk ``c`` and microbatch ``m``; ``D = (vp-1)*nm + pp``):
-      forward of work ``w_F = t - r``                  (valid in [0, nm*vp))
-      head (all ranks, vocab-sliced) of ``w_H = t - (pp-1)``
-                                          (valid in [nm*(vp-1), nm*vp))
-      backward of work ``u_B = t - D - (pp-1-r)``      (valid in [0, nm*vp))
-      with backward chunk ``c_B = vp-1 - u_B//nm`` descending — the reverse
-      of the forward's circular chunk order.
-    ``T = (2*vp - 1)*nm + 2*pp - 1`` ticks total (the classic
-    ``nm + 2*pp - 1`` at vp == 1).  The head's dy for ``m`` lands in the
-    ``dy_next`` carry at tick ``(vp-1)*nm + m + pp - 1`` and the last rank
-    consumes it one tick later — exactly when its B(vp-1, m) is scheduled.
-    Chunk hand-off rides two circular stores: forward chunk ``c`` -> ``c+1``
-    through ``circ`` on rank 0 (as in the wavefront), backward chunk ``c``
-    -> ``c-1`` through ``bcirc`` on rank ``pp-1`` (rank 0's dgrad output
-    comes around the reverse ring one tick later and waits for chunk
-    ``c-1``'s B tick) — both need ``nm >= pp`` (write-before-read).
+    The schedule is DATA, not control flow: one ``lax.scan`` over the
+    compacted work table (``work_table`` — ``wt_rank`` carries this rank's
+    per-tick (kind, microbatch, chunk, ring-slot) entries pipe-sharded on
+    their rank dim, ``wt_glob`` the tick-uniform gates and ring
+    bookkeeping).  Each tick gates its forward / head / backward / wgrad
+    blocks on the table's ``has_*`` flags with ``lax.cond``: the predicates
+    are tick-only (identical on every device), so every collective inside a
+    taken branch — ring hops, head psums, embed feed and embed-cotangent
+    routing switches — still reaches its rendezvous on every device, while
+    a tick no rank forwards (backwards) on executes no stage compute at
+    all.  That is what cashes the priced bubble in wall-clock: the old
+    lockstep loop burned the full body on all
+    ``(2*vp - 1)*nm + 2*pp - 1`` ticks, the compacted loop runs F on
+    ``nm*vp + pp - 1`` ticks and B on ``nm*vp + pp - 1`` ticks (dense for
+    ``nm % pp == 0`` — the m-major pp-group interleave order overlaps the
+    F/B windows like plain 1F1B instead of serializing chunk-major).
 
-    ``zero_bubble`` (vp == 1) splits the backward: the B tick linearizes
-    w.r.t. the activation only (dgrad — the cotangent ring is identical to
-    plain 1F1B, so loss and activation math are bitwise-unchanged), parks
-    ``dy`` in a pp-slot ring, and the weight-gradient pass for ``m`` runs at
-    tick ``m + 2*pp - 1`` on EVERY rank — i.e. ``r`` ticks after rank
-    ``r``'s dgrad, exactly this rank's cooldown-bubble budget (ZB-H1).  The
-    wgrad re-linearizes the stage against the saved input: one extra stage
-    forward per microbatch, the remat trade the cost model prices.
-
-    Every collective (forward ring hop, reverse ring hop, head psums, embed
-    feed and embed-cotangent routing switches) executes unconditionally or
-    under tick-only gates, so all devices always reach the same rendezvous.
-    """
+    Stream alignment: rank ``r``'s F(c, m) runs exactly one tick after rank
+    ``r-1``'s (the forward ring-hop carry), rank ``r``'s B(c, m) exactly
+    one tick after rank ``r+1``'s (the reverse hop) — per-rank streams are
+    shifts of the table's rank-0 forward / last-rank backward streams.
+    Variable-latency hand-offs ride interval-allocated rings instead of
+    carry slots: the stage-input store (``inflight``), the forward chunk
+    ring on rank 0 (``circ``), the backward chunk ring on rank ``pp-1``
+    (``bcirc``), the head-dy park (``dy_ring`` — the head may seed its B
+    the SAME tick now), and zb's deferred-dy park (``wdy_ring``).  Under
+    ``zero_bubble`` the B tick computes dgrad only and the wgrad for
+    microbatch ``m`` runs at the table's rank-uniform fill tick — same dy,
+    same saved input, grads bitwise the plain-1F1B split into two
+    pullbacks."""
     rank = jax.lax.axis_index(PIPE_AXIS)
     is_first = rank == 0
     is_last = rank == pp - 1
@@ -827,13 +1207,6 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
     x0 = emb[0]
     cyclic = [(i, (i + 1) % pp) for i in range(pp)]
     reverse = [((i + 1) % pp, i) for i in range(pp)]
-    # stage-input save slots: vp == 1 keeps the O(pp) 2*pp ring (a
-    # microbatch's input is consumed at most 2*pp - 1 ticks after its save);
-    # the circular interleave keeps chunk-0 inputs live nearly the whole
-    # schedule, so vp > 1 stores all [vp*nm] work inputs (still below the
-    # wavefront's ~2 residuals per tick — the memory test pins it)
-    buf_n = nm * vp if vp > 1 else 2 * pp
-    dbase = (vp - 1) * nm + pp  # backward schedule offset D
 
     # normalize local layer layout: vp>1 arrives [vp, 1, Lc, ...] (dim1 is
     # the pipe shard) -> [vp, Lc, ...]; vp==1 stays flat [Lc, ...]
@@ -871,268 +1244,261 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
 
         return jax.tree_util.tree_map(one, dl, d_lp)
 
-    def tick(carry, t):
-        (recv, cot_recv, dy_next, inflight, circ, bcirc, dy_ring, d_layers,
-         d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
+    def ring_at(ring, slot):
+        return jax.lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
 
-        if vp > 1:
-            # forward chunk hand-off (rank 0): recv holds the last rank's
-            # chunk-c output from tick t-1 (work w_back); park it in the
-            # circular store for chunk c+1's slot
-            w_back = t - pp
-            m_back = jnp.clip(jnp.remainder(w_back, nm), 0, nm - 1)
-            back_valid = jnp.logical_and(w_back >= 0,
-                                         w_back < nm * (vp - 1))
-            slot = jax.lax.dynamic_index_in_dim(circ, m_back, 0,
-                                                keepdims=False)
-            circ = jax.lax.dynamic_update_index_in_dim(
-                circ, jnp.where(back_valid, recv, slot), m_back, 0
-            )
-            # backward chunk hand-off (rank pp-1): cot_recv holds rank 0's
-            # chunk-c dgrad from tick t-1 (work u_prev, chunks >= 1 only —
-            # chunk 0's cotangent routes to the embed feed instead); park it
-            # until chunk c-1's B tick
-            u_prev = (t - 1) - dbase - (pp - 1)
-            m_prev = jnp.clip(jnp.remainder(u_prev, nm), 0, nm - 1)
-            prev_valid = jnp.logical_and(u_prev >= 0,
-                                         u_prev < nm * (vp - 1))
-            bslot = jax.lax.dynamic_index_in_dim(bcirc, m_prev, 0,
-                                                 keepdims=False)
-            bcirc = jax.lax.dynamic_update_index_in_dim(
-                bcirc, jnp.where(prev_valid, cot_recv, bslot), m_prev, 0
-            )
+    def ring_put(ring, slot, value, valid):
+        cur = ring_at(ring, slot)
+        return jax.lax.dynamic_update_index_in_dim(
+            ring, jnp.where(valid, value, cur), slot, 0
+        )
 
-        # ---- forward ---------------------------------------------------
-        w_F = t - rank
-        f_valid = jnp.logical_and(w_F >= 0, w_F < nm * vp)
-        w_Fc = jnp.clip(w_F, 0, nm * vp - 1)
-        m_F = jnp.remainder(w_Fc, nm)
-        c_F = w_Fc // nm
-        mbF = _tree_index(microbatches, m_F)
-        e_t = jax.lax.dynamic_index_in_dim(
-            emb, jnp.clip(t // pp, 0, slots - 1), 0, keepdims=False
-        )
-        fresh = jax.lax.cond(
-            t < nm,
-            lambda: jax.lax.switch(
-                jnp.remainder(t, pp),
-                [functools.partial(
-                    jax.lax.ppermute, e_t, PIPE_AXIS, [(o, 0)]
-                ) for o in range(pp)],
-            ),
-            lambda: jnp.zeros(x0.shape, x0.dtype),
-        )
+    def tick(carry, xt):
+        (recv, cot_recv, inflight, circ, bcirc, dy_ring, wdy_ring,
+         d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
+
+        # ---- chunk hand-off parks (values hopped at the previous tick) -
+        # recv holds the predecessor's y from tick t-1: on rank 0 that is
+        # the last rank's output, parked for its next chunk; cot_recv holds
+        # the successor's dgrad: on rank pp-1 that is rank 0's, parked for
+        # the previous chunk's B tick.  The parked value is only meaningful
+        # on the owning rank (other ranks park garbage in their local ring,
+        # never read — the same SPMD trade the wavefront makes).
         if vp > 1:
-            parked_in = jax.lax.dynamic_index_in_dim(circ, m_F, 0,
-                                                     keepdims=False)
-            first_in = jnp.where(c_F == 0, fresh, parked_in)
-        else:
-            first_in = fresh
-        x_in = jnp.where(is_first, first_in, recv)
-        y, s_aux = stage_flat(chunk_layers(c_F), x_in, mbF, c_F)
+            circ = ring_put(circ, xt["cpark_slot"], recv, xt["cpark_valid"])
+            bcirc = ring_put(bcirc, xt["bpark_slot"], cot_recv,
+                             xt["bpark_valid"])
+
+        # ---- forward work ----------------------------------------------
+        m_F, c_F, f_valid = xt["f_m"], xt["f_c"], xt["f_valid"]
+
+        def f_block(inflight):
+            mbF = _tree_index(microbatches, m_F)
+            # rank 0 consumes microbatch m_F's embedding at its chunk-0 F
+            # tick: fetch it from its round-robin owner.  Branch index and
+            # gate are table columns — tick-uniform on every device.
+            e_t = jax.lax.dynamic_index_in_dim(
+                emb, xt["feed_slot"], 0, keepdims=False
+            )
+            fresh = jax.lax.cond(
+                xt["feed_valid"],
+                lambda: jax.lax.switch(
+                    xt["feed_src"],
+                    [functools.partial(
+                        jax.lax.ppermute, e_t, PIPE_AXIS, [(o, 0)]
+                    ) for o in range(pp)],
+                ),
+                lambda: jnp.zeros(x0.shape, x0.dtype),
+            )
+            if vp > 1:
+                parked_in = ring_at(circ, xt["cread_slot"])
+                first_in = jnp.where(c_F == 0, fresh, parked_in)
+            else:
+                first_in = fresh
+            x_in = jnp.where(is_first, first_in, recv)
+            y, s_aux = stage_flat(chunk_layers(c_F), x_in, mbF, c_F)
+            # save the stage input for this rank's B (and zb wgrad) tick
+            inflight = ring_put(inflight, xt["f_slot"], x_in, f_valid)
+            # forward ring hop: consumed by the successor's F next tick
+            hop = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
+            return y, s_aux, inflight, hop
+
+        y, s_aux, inflight, recv = jax.lax.cond(
+            xt["has_f"], f_block,
+            lambda inflight: (jnp.zeros(x0.shape, x0.dtype),
+                              jnp.zeros((), jnp.float32), inflight, recv),
+            inflight,
+        )
         aux_acc = aux_acc + jnp.where(f_valid, s_aux, 0.0)
-        # save the stage input for this rank's B tick
-        slot_F = w_Fc if vp > 1 else jnp.remainder(m_F, buf_n)
-        old = jax.lax.dynamic_index_in_dim(inflight, slot_F, 0, keepdims=False)
-        inflight = jax.lax.dynamic_update_index_in_dim(
-            inflight, jnp.where(f_valid, x_in, old), slot_F, 0
-        )
 
         # ---- head + CE (vocab sliced over pipe) ------------------------
-        w_H = t - (pp - 1)
-        h_valid = jnp.logical_and(w_H >= nm * (vp - 1), w_H < nm * vp)
-        m_Hc = jnp.clip(jnp.remainder(jnp.clip(w_H, 0, nm * vp - 1), nm),
-                        0, nm - 1)
-        y_bcast = jax.lax.psum(
-            jnp.where(
-                jnp.logical_and(is_last,
-                                jnp.logical_and(f_valid, c_F == vp - 1)),
-                y, 0.0,
-            ),
-            PIPE_AXIS,
-        )
-        mbH = _tree_index(microbatches, m_Hc)
-        # hidden fn under vjp over BOTH (hp, y) so the norm-weight grad and
-        # dy fall out of one pass; the CE backward below is closed-form
-        (h_out, head_vjp) = jax.vjp(head_hidden_fn, head_params, y_bcast)
-        if shift_labels:
-            h2 = h_out[:, :-1]
-            labels2 = mbH["labels"][:, 1:]
-            lmH = mbH.get("loss_mask")
-            lm2 = None if lmH is None else lmH[:, 1:]
-        else:
-            h2 = h_out
-            labels2 = mbH["labels"]
-            lmH = mbH.get("loss_mask")
-            lm2 = lmH
-        valid = labels2 != ignore_index
-        safe = jnp.where(valid, labels2, 0)
-        mask = valid.astype(jnp.float32)
-        if lm2 is not None:
-            mask = mask * lm2.astype(jnp.float32)
-        logits = jnp.einsum(
-            "bsh,vh->bsv", h2, w_r, preferred_element_type=jnp.float32
-        )
-        gmax = jax.lax.pmax(
-            jax.lax.stop_gradient(jnp.max(logits, axis=-1)), PIPE_AXIS
-        )
-        shifted = logits - gmax[..., None]
-        sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), PIPE_AXIS)
-        lse = jnp.log(sumexp) + gmax
-        off = rank * vr
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
-            + off == safe[..., None]
-        )
-        ll = jax.lax.psum(
-            jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1), PIPE_AXIS
-        )
-        loss_m = jnp.sum((lse - ll) * mask)
-        p_r = jnp.exp(shifted) / sumexp[..., None]
-        dlogits = (p_r - onehot.astype(jnp.float32)) * (mask / denom)[..., None]
-        dlogits = dlogits.astype(h2.dtype)
-        d_wr_t = jnp.einsum(
-            "bsv,bsh->vh", dlogits, h2, preferred_element_type=jnp.float32
-        )
-        dh2 = jax.lax.psum(
-            jnp.einsum("bsv,vh->bsh", dlogits, w_r,
-                       preferred_element_type=jnp.float32),
-            PIPE_AXIS,
-        ).astype(h_out.dtype)
-        if shift_labels:
-            dh = jnp.pad(
-                dh2, ((0, 0), (0, 1)) + ((0, 0),) * (dh2.ndim - 2)
+        def h_block(dy_ring, d_w, d_hp_acc, loss_acc):
+            # the head tick IS the last rank's final-chunk F tick: broadcast
+            # its fresh output over the pipe ring, then every rank computes
+            # logits for its V/pp vocab slice
+            m_H = xt["h_m"]
+            y_bcast = jax.lax.psum(
+                jnp.where(
+                    jnp.logical_and(is_last,
+                                    jnp.logical_and(f_valid, c_F == vp - 1)),
+                    y, 0.0,
+                ),
+                PIPE_AXIS,
             )
-        else:
-            dh = dh2
-        d_hp_t, dy_t = head_vjp(dh)
-        hv = h_valid.astype(jnp.float32)
-        loss_acc = loss_acc + hv * loss_m
-        d_w = d_w + hv * d_wr_t.astype(grad_dtype)
-        d_hp_acc = jax.tree_util.tree_map(
-            lambda a, gkk: a + hv * gkk.astype(grad_dtype), d_hp_acc, d_hp_t
+            mbH = _tree_index(microbatches, m_H)
+            # hidden fn under vjp over BOTH (hp, y) so the norm-weight grad
+            # and dy fall out of one pass; the CE backward is closed-form
+            (h_out, head_vjp) = jax.vjp(head_hidden_fn, head_params, y_bcast)
+            if shift_labels:
+                h2 = h_out[:, :-1]
+                labels2 = mbH["labels"][:, 1:]
+                lmH = mbH.get("loss_mask")
+                lm2 = None if lmH is None else lmH[:, 1:]
+            else:
+                h2 = h_out
+                labels2 = mbH["labels"]
+                lmH = mbH.get("loss_mask")
+                lm2 = lmH
+            valid = labels2 != ignore_index
+            safe = jnp.where(valid, labels2, 0)
+            mask = valid.astype(jnp.float32)
+            if lm2 is not None:
+                mask = mask * lm2.astype(jnp.float32)
+            logits = jnp.einsum(
+                "bsh,vh->bsv", h2, w_r, preferred_element_type=jnp.float32
+            )
+            gmax = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits, axis=-1)), PIPE_AXIS
+            )
+            shifted = logits - gmax[..., None]
+            sumexp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1),
+                                  PIPE_AXIS)
+            lse = jnp.log(sumexp) + gmax
+            off = rank * vr
+            onehot = (
+                jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                         logits.ndim - 1)
+                + off == safe[..., None]
+            )
+            ll = jax.lax.psum(
+                jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1), PIPE_AXIS
+            )
+            loss_m = jnp.sum((lse - ll) * mask)
+            p_r = jnp.exp(shifted) / sumexp[..., None]
+            dlogits = (p_r - onehot.astype(jnp.float32)) \
+                * (mask / denom)[..., None]
+            dlogits = dlogits.astype(h2.dtype)
+            d_wr_t = jnp.einsum(
+                "bsv,bsh->vh", dlogits, h2, preferred_element_type=jnp.float32
+            )
+            dh2 = jax.lax.psum(
+                jnp.einsum("bsv,vh->bsh", dlogits, w_r,
+                           preferred_element_type=jnp.float32),
+                PIPE_AXIS,
+            ).astype(h_out.dtype)
+            if shift_labels:
+                dh = jnp.pad(
+                    dh2, ((0, 0), (0, 1)) + ((0, 0),) * (dh2.ndim - 2)
+                )
+            else:
+                dh = dh2
+            d_hp_t, dy_t = head_vjp(dh)
+            loss_acc = loss_acc + loss_m
+            d_w = d_w + d_wr_t.astype(grad_dtype)
+            d_hp_acc = jax.tree_util.tree_map(
+                lambda a, gkk: a + gkk.astype(grad_dtype), d_hp_acc, d_hp_t
+            )
+            # park dy for the last rank's final-chunk B (same tick legal:
+            # this block precedes the backward block)
+            dy_ring = ring_put(dy_ring, xt["dyw_slot"],
+                               dy_t.astype(x0.dtype), True)
+            return dy_ring, d_w, d_hp_acc, loss_acc
+
+        dy_ring, d_w, d_hp_acc, loss_acc = jax.lax.cond(
+            xt["has_h"], h_block, lambda *a: a,
+            dy_ring, d_w, d_hp_acc, loss_acc,
         )
-        dy_new = jnp.where(h_valid, dy_t, jnp.zeros_like(dy_t))
 
         # ---- backward (full vjp, or dgrad-only under zero_bubble) ------
-        u_B = t - dbase - (pp - 1 - rank)
-        b_valid = jnp.logical_and(u_B >= 0, u_B < nm * vp)
-        u_Bc = jnp.clip(u_B, 0, nm * vp - 1)
-        m_B = jnp.remainder(u_Bc, nm)
-        c_B = (vp - 1) - u_Bc // nm
-        mbB = _tree_index(microbatches, m_B)
-        # saved-input slot is keyed by the FORWARD work index c_B*nm + m_B
-        # (the backward order index u_B runs chunks in reverse)
-        x_saved = jax.lax.dynamic_index_in_dim(
-            inflight,
-            c_B * nm + m_B if vp > 1 else jnp.remainder(m_B, buf_n), 0,
-            keepdims=False,
-        )
-        if vp > 1:
-            last_dy = jnp.where(
-                c_B == vp - 1, dy_next,
-                jax.lax.dynamic_index_in_dim(bcirc, m_B, 0, keepdims=False),
-            )
-        else:
-            last_dy = dy_next
-        dy_in = jnp.where(is_last, last_dy, cot_recv)
-        seed = (dy_in.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
-        bv = b_valid.astype(jnp.float32)
-        lp_B = chunk_layers(c_B)
+        m_B, c_B, b_valid = xt["b_m"], xt["b_c"], xt["b_valid"]
 
+        def b_block(wdy_ring, d_layers, d_emb):
+            mbB = _tree_index(microbatches, m_B)
+            x_saved = ring_at(inflight, xt["b_slot"])
+            dy_parked = ring_at(dy_ring, xt["dyr_slot"])
+            if vp > 1:
+                last_dy = jnp.where(
+                    c_B == vp - 1, dy_parked,
+                    ring_at(bcirc, xt["bread_slot"]),
+                )
+            else:
+                last_dy = dy_parked
+            dy_in = jnp.where(is_last, last_dy, cot_recv)
+            seed = (dy_in.astype(x0.dtype),
+                    jnp.asarray(aux_scale, jnp.float32))
+            bv = b_valid.astype(jnp.float32)
+            lp_B = chunk_layers(c_B)
+
+            if zero_bubble:
+                # dgrad only: the activation cotangent unblocks the
+                # upstream stage this tick; dy parks for the table's
+                # deferred wgrad fill tick
+                _, x_vjp = jax.vjp(lambda x: stage_flat(lp_B, x, mbB, c_B),
+                                   x_saved)
+                (d_x_t,) = x_vjp(seed)
+                wdy_ring = ring_put(wdy_ring, xt["bdy_slot"], dy_in, b_valid)
+            else:
+                def stage_for_vjp(lp, x):
+                    return stage_flat(lp, x, mbB, c_B)
+
+                _, stage_vjp = jax.vjp(stage_for_vjp, lp_B, x_saved)
+                d_lp_t, d_x_t = stage_vjp(seed)
+                d_layers = acc_layers(d_layers, d_lp_t, c_B, bv)
+            d_x_masked = jnp.where(b_valid, d_x_t, jnp.zeros_like(d_x_t))
+
+            # embed cotangent: rank 0's chunk-0 d_x routes back to its
+            # round-robin owner (the reverse of the embed feed) — gate and
+            # destination are table columns, tick-uniform
+            d_x0 = jnp.where(is_first, d_x_masked, jnp.zeros_like(d_x_masked))
+            routed = jax.lax.cond(
+                xt["d0_valid"],
+                lambda: jax.lax.switch(
+                    xt["d0_dst"],
+                    [functools.partial(
+                        jax.lax.ppermute, d_x0, PIPE_AXIS, [(0, o)]
+                    ) for o in range(pp)],
+                ),
+                lambda: jnp.zeros_like(d_x0),
+            )
+            mine = jnp.logical_and(xt["d0_valid"], xt["d0_dst"] == rank)
+            d_emb = ring_put(d_emb, xt["d0_slot"],
+                             routed.astype(grad_dtype), mine)
+            # reverse ring hop: consumed by the predecessor's B next tick
+            cot_hop = jax.lax.ppermute(d_x_masked, PIPE_AXIS, reverse)
+            return wdy_ring, d_layers, d_emb, cot_hop
+
+        wdy_ring, d_layers, d_emb, cot_recv = jax.lax.cond(
+            xt["has_b"], b_block,
+            lambda wdy_ring, d_layers, d_emb: (wdy_ring, d_layers, d_emb,
+                                               cot_recv),
+            wdy_ring, d_layers, d_emb,
+        )
+
+        # ---- deferred wgrad (zb fill ticks — rank-uniform, fully dense) -
         if zero_bubble:
-            # dgrad only: the activation cotangent unblocks the upstream
-            # stage this tick; dy parks in the pp-slot ring for the wgrad
-            # pass r ticks later (same dy, same saved input — grads are
-            # bitwise the plain-1F1B split into two pullbacks)
-            _, x_vjp = jax.vjp(lambda x: stage_flat(lp_B, x, mbB, c_B),
-                               x_saved)
-            (d_x_t,) = x_vjp(seed)
-            slot_D = jnp.remainder(m_B, pp)
-            old_dy = jax.lax.dynamic_index_in_dim(dy_ring, slot_D, 0,
-                                                  keepdims=False)
-            dy_ring = jax.lax.dynamic_update_index_in_dim(
-                dy_ring, jnp.where(b_valid, dy_in, old_dy), slot_D, 0
-            )
-        else:
-            def stage_for_vjp(lp, x):
-                return stage_flat(lp, x, mbB, c_B)
+            def w_block(d_layers):
+                m_W = xt["w_m"]
+                mbW = _tree_index(microbatches, m_W)
+                x_w = ring_at(inflight, xt["w_x_slot"])
+                dy_w = ring_at(wdy_ring, xt["w_dy_slot"])
+                _, lp_vjp = jax.vjp(
+                    lambda lp: stage_flat(lp, x_w, mbW,
+                                          jnp.zeros((), jnp.int32)),
+                    local_layers,
+                )
+                (d_lp_w,) = lp_vjp(
+                    (dy_w.astype(x0.dtype),
+                     jnp.asarray(aux_scale, jnp.float32))
+                )
+                return acc_layers(d_layers, d_lp_w, 0,
+                                  xt["w_valid"].astype(jnp.float32))
 
-            _, stage_vjp = jax.vjp(stage_for_vjp, lp_B, x_saved)
-            d_lp_t, d_x_t = stage_vjp(seed)
-            d_layers = acc_layers(d_layers, d_lp_t, c_B, bv)
-        d_x_masked = jnp.where(b_valid, d_x_t, jnp.zeros_like(d_x_t))
+            d_layers = jax.lax.cond(
+                xt["has_w"], w_block, lambda d_layers: d_layers, d_layers
+            )
 
-        if zero_bubble:
-            # ---- deferred wgrad (ZB-H1 cooldown fill) ------------------
-            # microbatch m's weight grads on EVERY rank at tick
-            # m + 2*pp - 1 = rank r's dgrad tick + r: the wgrad work slides
-            # into exactly the ticks rank r would idle through in cooldown.
-            # x is still live in the 2*pp inflight ring (overwritten only at
-            # tick m + 2*pp + r) and dy in the pp-slot ring (at m + pp's
-            # dgrad, tick m + 3*pp - 1 - r > this read for every r < pp).
-            m_W = t - (2 * pp - 1)
-            w_valid = jnp.logical_and(m_W >= 0, m_W < nm)
-            m_Wc = jnp.clip(m_W, 0, nm - 1)
-            mbW = _tree_index(microbatches, m_Wc)
-            x_w = jax.lax.dynamic_index_in_dim(
-                inflight, jnp.remainder(m_Wc, buf_n), 0, keepdims=False
-            )
-            dy_w = jax.lax.dynamic_index_in_dim(
-                dy_ring, jnp.remainder(m_Wc, pp), 0, keepdims=False
-            )
-            _, lp_vjp = jax.vjp(
-                lambda lp: stage_flat(lp, x_w, mbW,
-                                      jnp.zeros((), jnp.int32)),
-                local_layers,
-            )
-            (d_lp_w,) = lp_vjp(
-                (dy_w.astype(x0.dtype), jnp.asarray(aux_scale, jnp.float32))
-            )
-            d_layers = acc_layers(d_layers, d_lp_w, 0,
-                                  w_valid.astype(jnp.float32))
-
-        # embed cotangent: rank 0's chunk-0 d_x for microbatch m0 routes
-        # back to its round-robin owner (the reverse of the embed feed),
-        # tick-uniform.  Chunk-0 backwards on rank 0 occupy exactly the
-        # window [off, off + nm).
-        off = 2 * (vp - 1) * nm + 2 * pp - 1
-        m0 = t - off
-        m0_valid = jnp.logical_and(m0 >= 0, m0 < nm)
-        m0c = jnp.clip(m0, 0, nm - 1)
-        d_x0 = jnp.where(is_first, d_x_masked, jnp.zeros_like(d_x_masked))
-        routed = jax.lax.cond(
-            jnp.logical_and(t >= off, t < nm + off),
-            lambda: jax.lax.switch(
-                jnp.remainder(m0c, pp),
-                [functools.partial(
-                    jax.lax.ppermute, d_x0, PIPE_AXIS, [(0, o)]
-                ) for o in range(pp)],
-            ),
-            lambda: jnp.zeros_like(d_x0),
-        )
-        mine = jnp.logical_and(m0_valid, jnp.remainder(m0c, pp) == rank)
-        p_slot = m0c // pp
-        cur = jax.lax.dynamic_index_in_dim(d_emb, p_slot, 0, keepdims=False)
-        d_emb = jax.lax.dynamic_update_index_in_dim(
-            d_emb,
-            jnp.where(mine, routed.astype(grad_dtype), cur), p_slot, 0,
-        )
-
-        # ---- ring hops -------------------------------------------------
-        recv = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
-        cot_recv = jax.lax.ppermute(d_x_masked, PIPE_AXIS, reverse)
-        return (recv, cot_recv, dy_new, inflight, circ, bcirc, dy_ring,
+        return (recv, cot_recv, inflight, circ, bcirc, dy_ring, wdy_ring,
                 d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc), None
 
     zeros = jnp.zeros_like(x0)
-    inflight0 = jnp.zeros((buf_n,) + x0.shape, x0.dtype)
-    circ0 = (jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1
+    inflight0 = jnp.zeros((rings["inflight"],) + x0.shape, x0.dtype)
+    circ0 = (jnp.zeros((rings["circ"],) + x0.shape, x0.dtype) if vp > 1
              else jnp.zeros((1, 1), x0.dtype))
-    bcirc0 = (jnp.zeros((nm,) + x0.shape, x0.dtype) if vp > 1
+    bcirc0 = (jnp.zeros((rings["bcirc"],) + x0.shape, x0.dtype) if vp > 1
               else jnp.zeros((1, 1), x0.dtype))
-    dy_ring0 = (jnp.zeros((pp,) + x0.shape, x0.dtype) if zero_bubble
-                else jnp.zeros((1, 1), x0.dtype))
+    dy_ring0 = jnp.zeros((rings["dy"],) + x0.shape, x0.dtype)
+    wdy_ring0 = (jnp.zeros((rings["wdy"],) + x0.shape, x0.dtype)
+                 if zero_bubble else jnp.zeros((1, 1), x0.dtype))
     d_layers0 = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, grad_dtype), local_layers
     )
@@ -1141,12 +1507,13 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom, *,
     d_hp0 = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, grad_dtype), head_params
     )
-    carry0 = (zeros, jnp.zeros_like(x0), jnp.zeros_like(x0), inflight0,
-              circ0, bcirc0, dy_ring0, d_layers0, d_emb0, d_w0, d_hp0,
-              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
-    carry, _ = jax.lax.scan(
-        tick, carry0, jnp.arange((2 * vp - 1) * nm + 2 * pp - 1)
-    )
+    carry0 = (zeros, jnp.zeros_like(x0), inflight0,
+              circ0, bcirc0, dy_ring0, wdy_ring0, d_layers0, d_emb0, d_w0,
+              d_hp0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    # per-rank columns arrive [T, 1] (pipe-sharded on dim 1) -> [T]; the
+    # scan consumes one row of the table per compacted tick
+    xs = {**{k: v[:, 0] for k, v in wt_rank.items()}, **wt_glob}
+    carry, _ = jax.lax.scan(tick, carry0, xs)
     (_, _, _, _, _, _, _, d_layers, d_emb, d_w, d_hp_acc, loss_acc,
      aux_acc) = carry
     if vp > 1:

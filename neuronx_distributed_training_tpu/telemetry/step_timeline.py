@@ -51,18 +51,28 @@ MAX_TICK_ROWS = 160
 
 def pipeline_facts(schedule: Optional[str], pp: int, num_microbatches: int,
                    vp: int = 1,
-                   bubble_fraction_predicted: Optional[float] = None
+                   bubble_fraction_predicted: Optional[float] = None,
+                   ticks_per_step: Optional[Mapping[str, int]] = None
                    ) -> dict[str, Any]:
     """The schedule facts the timeline reconstruction needs — built once by
     the trainer (which already knows them) and threaded through the trace
-    capture so the analysis never re-derives scheduling from config."""
-    return {
+    capture so the analysis never re-derives scheduling from config.
+
+    ``ticks_per_step`` carries the work-compacted executor's tick counts
+    (``parallel.pipeline.WorkTable.tick_counts``) for the manual-vjp
+    schedules: on a compacted execution the number of detected ticks is NOT
+    the old lockstep trip count — the summary echoes the expected counts so
+    a reader can tell compaction from a broken marker chain."""
+    out = {
         "schedule": schedule,
         "pp": int(pp),
         "num_microbatches": int(num_microbatches),
         "vp": int(vp or 1),
         "bubble_fraction_predicted": bubble_fraction_predicted,
     }
+    if ticks_per_step:
+        out["ticks_per_step"] = dict(ticks_per_step)
+    return out
 
 
 def _pp_marker_kinds() -> tuple[str, ...]:
@@ -206,6 +216,11 @@ def analyze_pipeline(events: Iterable[dict], *,
         "ticks_detected": ticks_total,
         "ticks_truncated": ticks_total > len(tick_rows),
     }
+    if facts.get("ticks_per_step"):
+        # the compacted executor's expected per-step tick counts (schedule
+        # table, not a measurement): detected ticks on a compacted run are
+        # bounded by the executed hop count, not the lockstep trip count
+        out["ticks_per_step"] = dict(facts["ticks_per_step"])
     if predicted is not None:
         out["bubble_residual"] = round(measured - float(predicted), 6)
     return out

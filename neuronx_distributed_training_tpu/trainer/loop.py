@@ -877,7 +877,9 @@ class Trainer:
             exp.throughput.seq_len = seq_len
         n_chips = int(mesh.devices.size)
         from neuronx_distributed_training_tpu.parallel.pipeline import (
+            MANUAL_VJP_SCHEDULES,
             predicted_bubble_fraction,
+            work_table,
         )
 
         run_facts: dict = {
@@ -890,6 +892,17 @@ class Trainer:
                 pp_schedule, pp, int(sched["num_microbatches"]),
                 int(mesh_cfg.virtual_pipeline_model_parallel_size or 1)), 6),
         }
+        # the manual-vjp schedules run the WORK-COMPACTED executor: record
+        # its per-step tick counts (compacted span + per-kind active ticks
+        # vs the old lockstep trip count) so the measured timelines are
+        # interpretable from run_summary.json alone
+        ticks_per_step = None
+        if pp_schedule in MANUAL_VJP_SCHEDULES:
+            ticks_per_step = work_table(
+                pp_schedule, pp, int(sched["num_microbatches"]),
+                int(mesh_cfg.virtual_pipeline_model_parallel_size or 1),
+            ).tick_counts()
+            run_facts["pipeline_ticks_per_step"] = ticks_per_step
         # arm the trace capture's pipeline-timeline reconstruction: with
         # pp > 1 a closed telemetry.trace window reconstructs the per-stage
         # tick Gantt and writes bubble_fraction_measured beside the
@@ -901,7 +914,8 @@ class Trainer:
         exp.set_pipeline_facts(pipeline_facts(
             pp_schedule, pp, int(sched["num_microbatches"]),
             int(mesh_cfg.virtual_pipeline_model_parallel_size or 1),
-            run_facts["bubble_fraction_predicted"]))
+            run_facts["bubble_fraction_predicted"],
+            ticks_per_step=ticks_per_step))
         try:
             fwd_flops = _perf.flops_for_model(model_cfg, seq_len)
             run_facts["fwd_flops_per_token"] = fwd_flops

@@ -189,13 +189,19 @@ class TestGoldenPlans:
     deliberate decision (update the snapshot in the same commit)."""
 
     @pytest.mark.parametrize("config,chips,topo,want", [
+        # the work-compacted executor's interval-allocated chunk-input ring
+        # is O(pp*vp) instead of the old lockstep O(vp*nm) store, so the
+        # interleave now FITS at large nm and its smaller bubble wins the
+        # same mesh (PR: cash the pipeline bubbles)
         (f"{EX}/hf_llama3_8B_config.yaml", 256, "v5e",
          Plan(tp=8, pp=4, cp=1, ep=1, dp=8, micro_batch_size=1,
-              num_microbatches=128, remat="selective", schedule="1f1b")),
-        # the 70B winner IS the shipped config's declared layout
+              num_microbatches=128, remat="selective",
+              schedule="1f1b-interleaved", vp=4)),
+        # the 70B winner IS the shipped config's declared mesh layout
         (f"{EX}/hf_llama3_70B_config.yaml", 256, "v5e",
          Plan(tp=32, pp=8, cp=1, ep=1, dp=1, micro_batch_size=1,
-              num_microbatches=1024, remat="selective", schedule="1f1b")),
+              num_microbatches=1024, remat="selective",
+              schedule="1f1b-interleaved", vp=2)),
         (f"{EX}/tiny_smoke_config.yaml", 8, "cpu",
          Plan(tp=2, pp=1, cp=1, ep=1, dp=4, micro_batch_size=2,
               num_microbatches=1, remat="none", schedule="none")),

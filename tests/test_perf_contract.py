@@ -657,6 +657,43 @@ class TestReportSurfaces:
         assert "/device:TPU:0" in out and "/device:TPU:1" in out
         assert "tick gantt" in out
 
+    def test_trace_report_gantt_aligns_unequal_tick_counts(self, capsys):
+        """Compacted timelines: stages detect different tick counts, so the
+        Gantt columns are TIME buckets — a stage with fewer ticks must not
+        be stretched to the full axis (the old per-tick-index rendering
+        assumed a shared tick axis)."""
+        tr = _load_tool("trace_report")
+        summary = {"pipeline": {
+            "schedule": "1f1b", "pp": 2, "num_microbatches": 4, "vp": 1,
+            "lane_resolution": "device", "num_lanes": 2,
+            "bubble_fraction_measured": 0.2,
+            "stages": {"/device:TPU:0": {"stage": 0, "ticks_detected": 4,
+                                         "busy_seconds": 1.0},
+                       "/device:TPU:1": {"stage": 1, "ticks_detected": 2,
+                                         "busy_seconds": 1.0}},
+            "straggler_stage": "/device:TPU:0",
+            "ticks": (
+                # stage 0: four 100us ticks covering [0, 400us)
+                [{"stage": 0, "tick": t, "start_us": t * 100.0,
+                  "dur_us": 100.0, "busy_fraction": 1.0} for t in range(4)]
+                # stage 1: TWO ticks, busy only in the middle [100, 300us)
+                + [{"stage": 1, "tick": 0, "start_us": 100.0,
+                    "dur_us": 100.0, "busy_fraction": 1.0},
+                   {"stage": 1, "tick": 1, "start_us": 200.0,
+                    "dur_us": 100.0, "busy_fraction": 1.0}]),
+        }}
+        out = tr.render(summary)
+        bars = {}
+        for line in out.splitlines():
+            if "|" in line and "stage" in line:
+                stage = int(line.split("|")[0].split()[-1])
+                bars[stage] = line.split("|")[1]
+        # shared time axis: equal bar widths, 4 buckets
+        assert len(bars[0]) == len(bars[1]) == 4
+        assert bars[0] == "####"
+        # stage 1's ticks cover only [100, 300): idle columns at both ends
+        assert bars[1] == " ## "
+
     def test_metrics_report_renders_provenance_and_verdict(self, tmp_path,
                                                            capsys):
         mr = _load_tool("metrics_report")
@@ -793,3 +830,223 @@ def test_live_manual_vjp_schedule_trace_carries_measured_bubble(
     # and the perf-contract facts extractor reads the run dir whole
     facts = pc.perf_facts_from_run(run)
     assert facts["bubble_fraction_measured"] == pytest.approx(mb)
+
+
+# ---------------------------------------------------------------------------
+# compacted executions: committed pp=2 fixture where tick count != lockstep T
+# ---------------------------------------------------------------------------
+
+
+COMPACTED_FIXTURE = Path(__file__).parent / "data" \
+    / "pipeline_trace_compacted_fixture.trace.json"
+
+
+class TestCompactedTimelineFixture:
+    """The work-compacted executor's timeline: the committed fixture encodes
+    a pp=2 1f1b nm=4 COMPACTED window [0, 600us) — span 6 ticks where the
+    lockstep trip count was 7.  Stage 0 runs F full ticks 0..4 and a 40us
+    drain tail; stage 1 fill-idles tick 0 (only the gated hop runs) and
+    drain-idles tick 5.  Every number is hand-computable, and the fill/drain
+    idle is now VISIBLE idle (the lockstep executor burned compute there —
+    the 'no phantom masked-tick compute' property)."""
+
+    @pytest.fixture(scope="class")
+    def compacted(self):
+        from neuronx_distributed_training_tpu.parallel.pipeline import (
+            predicted_bubble_fraction,
+            work_table,
+        )
+
+        events = json.loads(COMPACTED_FIXTURE.read_text())["traceEvents"]
+        return analyze_pipeline(events, facts=pipeline_facts(
+            "1f1b", 2, 4, 1, predicted_bubble_fraction("1f1b", 2, 4, 1),
+            ticks_per_step=work_table("1f1b", 2, 4, 1).tick_counts()))
+
+    def test_tick_count_is_compacted_not_lockstep(self, compacted):
+        p = compacted
+        # 6 compacted ticks per lane resolved from the pp-hop markers —
+        # NOT the lockstep T = nm + 2pp - 1 = 7
+        lockstep = p["ticks_per_step"]["lockstep_span"]
+        assert lockstep == 7
+        for s in p["stages"].values():
+            assert s["ticks_detected"] == 6
+        assert p["ticks_detected"] == 12
+        assert p["ticks_per_step"]["span"] == 6
+        assert p["ticks_per_step"]["f_ticks"] == 5
+        assert p["ticks_per_step"]["b_ticks"] == 5
+
+    def test_busy_idle_split(self, compacted):
+        s0 = compacted["stages"]["/device:TPU:0"]
+        s1 = compacted["stages"]["/device:TPU:1"]
+        # stage 0: 5 full ticks + (40us tail + 10us hop) in the drain tick
+        assert s0["busy_seconds"] == pytest.approx(550e-6)
+        assert s0["idle_seconds"] == pytest.approx(50e-6)
+        # stage 1: fill tick 0 and drain tick 5 are 10us hop + 90us IDLE —
+        # real idle, not burned masked compute
+        assert s1["busy_seconds"] == pytest.approx(420e-6)
+        assert s1["idle_seconds"] == pytest.approx(180e-6)
+
+    def test_measured_bubble_lands_in_band(self, compacted):
+        p = compacted
+        # idle (50 + 180) over lane-time (2 x 600)
+        assert p["bubble_fraction_measured"] == pytest.approx(230 / 1200,
+                                                              abs=1e-6)
+        # the compacted prediction is the table's own accounting: 0.2 for
+        # 1f1b pp=2 nm=4 — the measurement lands within the PC302 band
+        assert p["bubble_fraction_predicted"] == pytest.approx(0.2)
+        assert abs(p["bubble_residual"]) < pc.DEFAULT_NOISE["bubble_abs"]
+
+    def test_no_pc302_on_compacted_run(self, compacted):
+        from neuronx_distributed_training_tpu.analysis.report import (
+            AuditReport,
+        )
+
+        facts = pc.perf_facts_from_trace_summary({"pipeline": compacted})
+        rep = AuditReport(config="t")
+        pc.calibration_findings(facts, pc.DEFAULT_NOISE, rep)
+        assert not [f for f in rep.findings if f.rule == "PC302"]
+
+    def test_ticks_per_step_passthrough(self, compacted):
+        # the facts' expected tick counts are echoed so a reader can tell
+        # compaction from a broken marker chain
+        assert compacted["ticks_per_step"]["w_ticks"] == 0
+        assert compacted["ticks_per_step"]["head_ticks"] == 4
+
+
+# ---------------------------------------------------------------------------
+# schedule-sweep contract rules (PC302 per row, PC303 ordering, row ratchet)
+# ---------------------------------------------------------------------------
+
+
+def _sweep_line(rows=None, **over):
+    line = {
+        "metric": "pipeline_schedule_sweep", "value": 0.93,
+        "unit": "interleaved_over_1f1b_step_time_ratio",
+        "vs_baseline": 0.93, "device": "cpu", "seq_len": 64,
+        "num_layers": 8, "pipeline_schedule": "sweep",
+        "schedule_sweep": {
+            "pp": 2, "nm": 16, "vp": 2,
+            "interleaved_over_1f1b": 0.93,
+            "rows": rows if rows is not None else [
+                {"schedule": "wavefront", "ms_per_step": 1680.0,
+                 "bubble_fraction_measured": 0.05,
+                 "bubble_fraction_predicted": 0.0303},
+                {"schedule": "1f1b", "ms_per_step": 1850.0,
+                 "bubble_fraction_measured": 0.06,
+                 "bubble_fraction_predicted": 0.0588},
+                {"schedule": "1f1b-interleaved", "ms_per_step": 1717.0,
+                 "bubble_fraction_measured": 0.05,
+                 "bubble_fraction_predicted": 0.0303},
+                {"schedule": "1f1b-zb", "ms_per_step": 2754.0,
+                 "bubble_fraction_measured": 0.07,
+                 "bubble_fraction_predicted": 0.0361},
+            ],
+        },
+    }
+    line.update(over)
+    return line
+
+
+class TestScheduleSweepRules:
+    def test_facts_extraction_normalizes_rows(self):
+        f = pc.perf_facts_from_bench(_sweep_line())
+        rows = {r["schedule"]: r for r in f["schedule_sweep"]}
+        assert set(rows) == {"wavefront", "1f1b", "1f1b-interleaved",
+                             "1f1b-zb"}
+        assert rows["1f1b"]["step_time_ms"] == pytest.approx(1850.0)
+        assert rows["1f1b-interleaved"]["bubble_fraction_predicted"] == \
+            pytest.approx(0.0303)
+
+    def test_default_key_separates_sweep_from_headline(self):
+        f = pc.perf_facts_from_bench(_sweep_line())
+        assert pc.default_key(f) == "cpu_schedule_sweep"
+        assert pc.default_key(pc.perf_facts_from_bench(_bench_line())) \
+            == "tpu_v5_lite_bench"
+
+    def _check(self, facts, noise=None):
+        from neuronx_distributed_training_tpu.analysis.report import (
+            AuditReport,
+        )
+
+        rep = AuditReport(config="t")
+        pc.calibration_findings(facts, dict(pc.DEFAULT_NOISE, **(noise or {})),
+                                rep)
+        return rep
+
+    def test_sweep_in_band_is_clean(self):
+        rep = self._check(pc.perf_facts_from_bench(_sweep_line()))
+        assert not rep.findings, rep.format()
+
+    def test_pc302_fires_per_row_naming_schedule(self):
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[2]["bubble_fraction_measured"] = 0.30  # interleaved idles
+        rep = self._check(pc.perf_facts_from_bench(_sweep_line(rows=rows)))
+        hits = [f for f in rep.findings if f.rule == "PC302"]
+        assert len(hits) == 1
+        assert hits[0].location == "1f1b-interleaved"
+        assert "1f1b-interleaved" in hits[0].message
+
+    def test_pc302_band_is_in_file_noise(self):
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[2]["bubble_fraction_measured"] = 0.30
+        rep = self._check(pc.perf_facts_from_bench(_sweep_line(rows=rows)),
+                          noise={"bubble_abs": 0.5})
+        assert not [f for f in rep.findings if f.rule == "PC302"]
+
+    def test_pc303_ordering_gate(self):
+        """The acceptance bar as a named finding: interleaved measuring
+        slower than plain 1f1b beyond the band is an error."""
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[2]["ms_per_step"] = 2400.0  # the lockstep-executor regression
+        rep = self._check(pc.perf_facts_from_bench(_sweep_line(rows=rows)))
+        hits = [f for f in rep.findings if f.rule == "PC303"]
+        assert len(hits) == 1
+        assert "ordering" in hits[0].message
+        assert "1f1b-interleaved" in hits[0].message
+
+    def test_pc303_within_band_is_clean(self):
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[2]["ms_per_step"] = 1900.0  # 2.7% over, inside the 10% band
+        rep = self._check(pc.perf_facts_from_bench(_sweep_line(rows=rows)))
+        assert not [f for f in rep.findings if f.rule == "PC303"]
+
+    def test_row_ratchet_pc101_names_schedule(self, tmp_path):
+        old = pc.perf_facts_from_bench(_sweep_line())
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[1]["ms_per_step"] = 9000.0  # 1f1b regressed ~5x
+        new = pc.perf_facts_from_bench(_sweep_line(rows=rows))
+        rep = pc.diff_facts(old, new)
+        hits = [f for f in rep.findings
+                if f.rule == "PC101" and f.location == "1f1b"]
+        assert len(hits) == 1 and "schedule sweep" in hits[0].message
+
+    def test_sweep_baseline_round_trip(self, tmp_path):
+        facts = pc.perf_facts_from_bench(_sweep_line())
+        pc.update_baseline("cpu_schedule_sweep", facts,
+                           baselines_dir=tmp_path,
+                           noise={"bubble_abs": 0.75})
+        rep = pc.check_perf("cpu_schedule_sweep", facts,
+                            baselines_dir=tmp_path)
+        assert pc.verdict_of(rep) == "clean", rep.format()
+        # a justified ordering regression records in-file
+        rows = _sweep_line()["schedule_sweep"]["rows"]
+        rows[2]["ms_per_step"] = 2400.0
+        bad = pc.perf_facts_from_bench(_sweep_line(rows=rows))
+        with pytest.raises(pc.PerfContractError, match="PC303"):
+            pc.update_baseline("cpu_schedule_sweep", bad,
+                               baselines_dir=tmp_path)
+
+    def test_committed_sweep_baseline_exists_and_is_wide_banded(self):
+        snap = pc.load_baseline("cpu_schedule_sweep")
+        assert snap is not None, \
+            "analysis/perf_baselines/cpu_schedule_sweep.json must be committed"
+        rows = {r["schedule"]: r
+                for r in (snap["facts"].get("schedule_sweep") or [])}
+        assert set(rows) >= {"wavefront", "1f1b", "1f1b-interleaved",
+                             "1f1b-zb"}
+        # the measured ordering IS the committed claim
+        assert rows["1f1b-interleaved"]["step_time_ms"] <= \
+            rows["1f1b"]["step_time_ms"] * (1 + pc.DEFAULT_NOISE["sweep_order_frac"])
+        # CPU lanes time-share host cores: the bubble band must be
+        # explicitly widened in-file (the TPU default stays tight)
+        assert snap["noise"]["bubble_abs"] > pc.DEFAULT_NOISE["bubble_abs"]

@@ -33,8 +33,10 @@ from neuronx_distributed_training_tpu.parallel.pipeline import (
     pipeline_loss_and_grad,
     predicted_bubble_fraction,
     resolve_schedule,
+    ring_slot_counts,
     supports_1f1b,
     to_interleaved,
+    work_table,
 )
 from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
 
@@ -544,6 +546,116 @@ class TestBubbleModel:
             == pytest.approx(16 / 19)
 
 
+class TestWorkTable:
+    """The work-compacted schedule table (schedule as data): the executor's
+    trip counts, orderings, and ring bounds are host-side facts that must
+    hold by construction."""
+
+    @pytest.mark.parametrize("sched,pp,nm,vp", [
+        ("1f1b", 2, 4, 1), ("1f1b", 4, 8, 1), ("1f1b", 2, 16, 1),
+        ("1f1b-interleaved", 2, 4, 2), ("1f1b-interleaved", 2, 16, 2),
+        ("1f1b-interleaved", 4, 8, 2),
+    ])
+    def test_table_realizes_priced_bubble(self, sched, pp, nm, vp):
+        """The compacted table's own bubble accounting equals the planner's
+        closed-form b/(1+b) for 1f1b and the m-major interleave (nm % pp ==
+        0): the executor realizes EXACTLY the priced asymptotics — the
+        claim the old lockstep executor could not make."""
+        b = bubble_multiplier(sched, pp, nm, vp)
+        assert work_table(sched, pp, nm, vp).bubble_fraction() \
+            == pytest.approx(b / (1 + b))
+        assert predicted_bubble_fraction(sched, pp, nm, vp) \
+            == pytest.approx(b / (1 + b))
+
+    def test_compacted_span_below_lockstep(self):
+        for sched, pp, nm, vp in [("1f1b", 2, 16, 1),
+                                  ("1f1b-interleaved", 2, 16, 2),
+                                  ("1f1b-zb", 2, 16, 1)]:
+            t = work_table(sched, pp, nm, vp)
+            assert t.span < t.lockstep_span, (sched, t.tick_counts())
+
+    def test_dense_windows(self):
+        """nm % pp == 0: the F and B windows are exactly nm*vp + pp - 1
+        active ticks each — the compacted executor runs no more stage
+        computations than the work demands plus the fill/drain triangles."""
+        for sched, pp, nm, vp in [("1f1b", 2, 16, 1),
+                                  ("1f1b-interleaved", 2, 16, 2)]:
+            tc = work_table(sched, pp, nm, vp).tick_counts()
+            assert tc["f_ticks"] == nm * vp + pp - 1
+            assert tc["b_ticks"] == nm * vp + pp - 1
+            assert tc["head_ticks"] == nm
+
+    def test_interleave_ring_bound_beats_old_store(self):
+        """The m-major interleave's interval-allocated rings are bounded by
+        the schedule's true in-flight window — STRICTLY below the old
+        lockstep store (vp*nm chunk inputs + two nm-slot hand-off rings)
+        at the acceptance point pp=2/nm=16/vp=2, and independent of nm."""
+        rings16 = ring_slot_counts("1f1b-interleaved", 2, 16, 2)
+        assert rings16["total"] < 2 * 16  # old chunk-input store alone
+        assert rings16["inflight"] < 2 * 16
+        # nm-independence: the ring is a window, not a per-microbatch store
+        rings32 = ring_slot_counts("1f1b-interleaved", 2, 32, 2)
+        assert rings32["inflight"] == rings16["inflight"]
+        assert rings32["total"] == rings16["total"]
+
+    def test_zb_wgrad_fill_is_dense(self):
+        """ZB's deferred wgrads land on rank-uniform fill ticks: every rank
+        does a VALID wgrad on every wgrad tick (no masked wgrad burn)."""
+        t = work_table("1f1b-zb", 4, 8, 1)
+        w_valid = t.rank_cols["w_valid"]
+        has_w = t.glob_cols["has_w"]
+        assert int(has_w.sum()) == 8  # one dense tick per microbatch
+        assert (w_valid[has_w].all(axis=1)).all()
+
+    def test_slot_lifetimes_collision_free(self):
+        """Re-derive every ring value's write->last-read lifetime from the
+        table columns and assert no two values overlap in a slot."""
+        for sched, pp, nm, vp in [("1f1b", 2, 6, 1),
+                                  ("1f1b-interleaved", 4, 6, 2),
+                                  ("1f1b-zb", 2, 6, 1)]:
+            t = work_table(sched, pp, nm, vp)
+            r, g = t.rank_cols, t.glob_cols
+            for rank in range(pp):
+                lives = {}  # slot -> list of (write, last_read)
+                for tk in range(t.span):
+                    if r["f_valid"][tk, rank]:
+                        key = (int(r["f_c"][tk, rank]),
+                               int(r["f_m"][tk, rank]))
+                        lives.setdefault(int(r["f_slot"][tk, rank]),
+                                         []).append([key, tk, tk])
+                for tk in range(t.span):
+                    for col, slot_col in (("b_valid", "b_slot"),
+                                          ("w_valid", "w_x_slot")):
+                        if col == "w_valid" and sched != "1f1b-zb":
+                            continue
+                        if r[col][tk, rank]:
+                            slot = int(r[slot_col][tk, rank])
+                            for rec in lives.get(slot, []):
+                                kc, km = rec[0]
+                                mm = int(r["b_m" if col == "b_valid"
+                                           else "w_m"][tk, rank])
+                                cc = int(r["b_c"][tk, rank]) \
+                                    if col == "b_valid" else kc
+                                if (kc, km) == (cc, mm):
+                                    rec[2] = max(rec[2], tk)
+                for slot, recs in lives.items():
+                    recs.sort(key=lambda rec: rec[1])
+                    for a, b in zip(recs, recs[1:]):
+                        assert a[2] < b[1], (
+                            f"{sched} rank {rank} slot {slot}: value "
+                            f"{a[0]} (live to {a[2]}) collides with "
+                            f"{b[0]} (written {b[1]})")
+            assert g["has_f"].any() and g["has_b"].any()
+
+    def test_rejects_non_manual_schedules(self):
+        with pytest.raises(ValueError, match="manual-vjp"):
+            work_table("wavefront", 2, 4)
+        with pytest.raises(ValueError, match="inconsistent"):
+            work_table("1f1b", 2, 4, vp=2)
+        with pytest.raises(ValueError, match="pp > 1"):
+            work_table("1f1b", 1, 4)
+
+
 class TestMemoryBound:
     """The schedule's reason to exist, pinned via compiled memory analysis.
 
@@ -664,6 +776,49 @@ class TestMemoryBound:
         assert zb <= 1.15 * f1b, detail
         assert il <= wf_vp, detail
 
+    def test_interleave_ring_memory_sublinear_in_nm(self, devices8):
+        """The compacted executor's interval-allocated chunk-input ring is
+        bounded by the schedule's in-flight window, not by nm: compiled
+        temp bytes of the interleave grow by ~1 activation per extra
+        microbatch (the embed feed + its cotangent — unavoidable), NOT the
+        old lockstep store's ~(vp+2) activations per microbatch."""
+        import dataclasses
+
+        from tests.conftest import lower_in_mesh
+
+        cfg = dataclasses.replace(
+            CFG, vocab_size=64, hidden_size=256, intermediate_size=256,
+            num_attention_heads=2, num_kv_heads=2, max_position_embeddings=128,
+        )
+        mb, s, vp = 8, 128, 2
+        act_bytes = mb * s * cfg.hidden_size * 4
+        embed_fn, stage_fn, _lf = llama.pipeline_hooks(cfg, FP32)
+        hh, hp_of, hw_of, _fold = llama.onef1b_head_hooks(cfg, FP32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg, FP32)
+        mesh = build_mesh(MeshConfig(pipeline_model_parallel_size=2,
+                                     virtual_pipeline_model_parallel_size=vp))
+
+        temps = {}
+        for nm in (8, 16):
+            mbs = microbatches(jax.random.PRNGKey(1), nm=nm, mb=mb, s=s,
+                               vocab=cfg.vocab_size)
+            shp, shm = shard_for(mesh, cfg, params, mbs, vp=vp)
+
+            def il(p, m):
+                return pipeline_loss_and_grad(
+                    p, p["layers"], m, embed_fn=embed_fn, stage_fn=stage_fn,
+                    head_hidden_fn=hh, head_params=hp_of(p),
+                    head_weight=hw_of(p), mesh=mesh, virtual_pipeline_size=vp)
+
+            temps[nm] = lower_in_mesh(mesh, il, shp, shm) \
+                .memory_analysis().temp_size_in_bytes
+        slope = (temps[16] - temps[8]) / 8.0
+        detail = {"temps": temps, "act_bytes": act_bytes,
+                  "bytes_per_extra_mb": slope}
+        # old lockstep store: (vp+2) = 4 stage inputs per extra microbatch
+        # on top of the feed/cotangent pair; the ring bound drops that term
+        assert slope <= 2.5 * act_bytes, detail
+
 
 class TestTrainerDispatch:
     """The trainer builds the 1F1B loss+grad when the gate fires, feeding the
@@ -753,6 +908,10 @@ class TestTrainerDispatch:
         nm = 2  # gbs=8, mbs=1, dp=4 (8 devices / pp=2)
         assert t.run_facts["bubble_fraction_predicted"] == pytest.approx(
             predicted_bubble_fraction("1f1b-interleaved", 2, nm, 2), abs=1e-6)
+        # the compacted executor's per-step trip counts ride run_facts
+        ticks = t.run_facts["pipeline_ticks_per_step"]
+        assert ticks == work_table("1f1b-interleaved", 2, nm, 2).tick_counts()
+        assert ticks["span"] < ticks["lockstep_span"]
 
     def test_forced_1f1b_on_gpt_raises(self, devices8):
         """The family gate fires at trainer build with the gate's reason —

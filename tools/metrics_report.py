@@ -504,6 +504,12 @@ def census_section(summary: dict) -> str:
         if summary.get(key) is not None:
             v = summary[key]
             lines.append(f"  {key:<21} {_fmt(v) if isinstance(v, (int, float)) else v}")
+    ticks = summary.get("pipeline_ticks_per_step")
+    if isinstance(ticks, dict) and ticks:
+        # the work-compacted executor's per-step trip counts (span +
+        # per-kind active ticks vs the old lockstep count)
+        lines.append("  ticks_per_step        "
+                     + ", ".join(f"{k}={ticks[k]}" for k in sorted(ticks)))
     if summary.get("retrace_events"):
         lines.append(f"  retrace_events        {len(summary['retrace_events'])} "
                      f"(see run_summary.json — each cost a recompile)")

@@ -172,19 +172,51 @@ def render(summary: dict, *, top: int = 10) -> str:
                                        "busy%", "collective")))
         ticks = pipe.get("ticks") or []
         if ticks:
-            # one ASCII Gantt row per stage: each tick a busy-level glyph
+            # one ASCII Gantt row per stage on a SHARED TIME AXIS: each
+            # glyph column is a time bucket, not a tick index.  On the
+            # work-compacted executor stages detect UNEQUAL tick counts
+            # (hops are gated per work kind), so indexing columns by tick
+            # would skew the rows against each other — the busy level of
+            # each tick lands in the buckets its [start_us, start_us +
+            # dur_us) interval actually covers.
             by_stage: dict = {}
             for t in ticks:
-                by_stage.setdefault(t.get("stage", 0), []).append(
-                    t.get("busy_fraction", 0.0))
+                by_stage.setdefault(t.get("stage", 0), []).append(t)
+            t_lo = min(t.get("start_us", 0.0) for t in ticks)
+            t_hi = max(t.get("start_us", 0.0) + t.get("dur_us", 0.0)
+                       for t in ticks)
+            max_ticks = max(len(ts) for ts in by_stage.values())
+            ncols = min(100, max_ticks)
+            note = ""
+            if pipe.get("ticks_truncated"):
+                note = ", truncated"
+            elif ncols < max_ticks:
+                # the axis is coarser than the tick stream: several ticks
+                # average into each glyph column
+                note = f", {max_ticks} ticks/{ncols} buckets"
+            lines.append("  tick gantt (busy per time bucket, ' '=idle "
+                         f"'#'=full{note})")
             glyphs = " .:-=#"
-            lines.append("  tick gantt (busy per tick, ' '=idle '#'=full"
-                         + (", truncated)" if pipe.get("ticks_truncated")
-                            else ")"))
-            for stage, fracs in sorted(by_stage.items()):
+            span = max(t_hi - t_lo, 1e-9)
+            col_us = span / ncols
+            for stage, stage_ticks in sorted(by_stage.items()):
+                level = [0.0] * ncols
+                covered = [0.0] * ncols
+                for t in stage_ticks:
+                    a = t.get("start_us", 0.0)
+                    b = a + t.get("dur_us", 0.0)
+                    busy = t.get("busy_fraction", 0.0)
+                    c0 = max(int((a - t_lo) / col_us), 0)
+                    c1 = min(int((b - t_lo) / col_us) + 1, ncols)
+                    for c in range(c0, c1):
+                        lo = t_lo + c * col_us
+                        ov = max(0.0, min(b, lo + col_us) - max(a, lo))
+                        level[c] += busy * ov
+                        covered[c] += ov
                 bar = "".join(
-                    glyphs[min(int(f * (len(glyphs) - 1) + 0.5),
-                               len(glyphs) - 1)] for f in fracs)
+                    glyphs[min(int(lv / cv * (len(glyphs) - 1) + 0.5),
+                               len(glyphs) - 1)] if cv > 0 else " "
+                    for lv, cv in zip(level, covered))
                 lines.append(f"    stage {stage}  |{bar}|")
         parts.append("\n".join(lines))
 
