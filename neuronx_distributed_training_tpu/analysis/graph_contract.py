@@ -178,6 +178,7 @@ class DeclaredComms:
     ulysses: bool
     ring: bool
     accum: bool = False  # gradient accumulation (num_microbatches > 1)
+    zero1_bucket: bool = False  # engineered overlap: bucketed ZeRO-1 gathers
 
     @classmethod
     def from_ctx(cls, ctx: Any) -> "DeclaredComms":
@@ -185,11 +186,14 @@ class DeclaredComms:
         dp_total = ctx.axis("data") * ctx.axis("expert")
         gbs = int(ctx.sched.get("global_batch_size", 1) or 1)
         mbs = int(ctx.sched.get("micro_batch_size", 1) or 1)
+        overlap = ctx.ds.get("overlap") or {}
         return cls(
             tp=ctx.axis("model"), pp=ctx.axis("pipe"),
             cp=ctx.axis("context"), ep=ctx.axis("expert"),
             dp=ctx.axis("data"),
             zero1=bool(ctx.ds.get("zero1", True)),
+            zero1_bucket=(bool(ctx.ds.get("zero1", True))
+                          and float(overlap.get("zero1_bucket_mb", 0) or 0) > 0),
             seq_par=bool(ctx.ds.get("sequence_parallel", False)),
             moe=bool((ctx.cfg.get("model", {}) or {}).get("moe")),
             ulysses=bool(fus.get("ulysses_attention")),
@@ -275,6 +279,20 @@ def declared_source_classes(d: DeclaredComms) -> list[tuple]:
             lambda a: a and a <= _DP_AXES,
             "ZeRO-1 gradient sharding changed; likely spec change in "
             "optim/zero1 (opt_state_specs)")
+        if d.zero1_bucket:
+            # engineered overlap (distributed_strategy.overlap.zero1_bucket_mb
+            # > 0): the optimizer packs eligible leaves per layer-group bucket
+            # and regathers each bucket with ONE combined all-gather under the
+            # optim.overlap.BUCKET_AG_SCOPE named scope.  A named class so the
+            # per-bucket collective-count growth is a justified fingerprint
+            # change, not ZeRO-1 regather noise — ordered BEFORE the generic
+            # rule; the scope corroboration keeps it from over-claiming.
+            add("zero1-bucket combined all-gather", ("all-gather",),
+                lambda a: a and a <= _DP_AXES,
+                "bucketed ZeRO-1 regather changed; check distributed_"
+                "strategy.overlap.zero1_bucket_mb and optim/overlap "
+                "build_bucket_plan (one combined all-gather per bucket)",
+                src=_src_any("zero1_bucket"))
         add("ZeRO-1 parameter all-gather", ("all-gather",),
             lambda a: a and a <= _DP_AXES,
             "ZeRO-1 resharding duplicated; likely spec change in optim/"

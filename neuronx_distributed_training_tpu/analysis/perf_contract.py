@@ -20,10 +20,13 @@ silently eroding the recorded baselines.
 - **the differ** — ``diff_facts`` explains a regression in subsystem terms
   (PC101 step time, PC102 throughput/MFU, PC201 per-class achieved
   overlap, PC202 exposed collective seconds naming the collective class,
-  PC301 measured bubble growth, PC302 measured-vs-predicted bubble outside
-  the calibration band, PC401 cost-model residual drift, PC501 measured
-  peak-HBM growth, PC502 measured peak HBM beyond the planner's predicted
-  total x the calibration band — PC302/PC502 are baseline-independent);
+  PC203 engineered-overlap ordering — multi-bucket + prefetch ZeRO-1
+  variants must expose at most the monolithic regather's collective
+  seconds within one ``--overlap-sweep`` run, PC301 measured bubble growth, PC302
+  measured-vs-predicted bubble outside the calibration band, PC401
+  cost-model residual drift, PC501 measured peak-HBM growth, PC502
+  measured peak HBM beyond the planner's predicted total x the calibration
+  band — PC203/PC302/PC502 are baseline-independent);
   improvements are PC110 info findings the snapshot can tighten to.
 - **the ratchet** — same workflow as graph contracts:
   ``tools/perf_contract.py --check`` fails on any error finding;
@@ -78,6 +81,15 @@ DEFAULT_NOISE: dict[str, float] = {
                                   # fails when interleaved measures slower
                                   # than plain 1f1b beyond this fraction
                                   # (the planner prices it at-or-below)
+    "overlap_order_frac": 0.25,   # overlap-sweep ordering slack: PC203 fails
+                                  # when the engineered (multi-bucket +
+                                  # prefetch) variant exposes more collective
+                                  # seconds than the monolithic regather
+                                  # beyond this fraction. Wider than
+                                  # sweep_order_frac because exposed seconds
+                                  # come from trace intervals, which jitter
+                                  # harder under host scheduling than whole
+                                  # step times do.
 }
 
 #: which subsystem a measured collective class's regression points at —
@@ -192,7 +204,30 @@ def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
         "residuals": payload.get("residuals")
         if isinstance(payload.get("residuals"), Mapping) else None,
         "schedule_sweep": _sweep_rows(payload.get("schedule_sweep")),
+        "overlap_sweep": _overlap_rows(payload.get("overlap_sweep")),
     }
+
+
+def _overlap_rows(sweep: Any) -> Optional[list[dict[str, Any]]]:
+    """Normalize a ``bench.py --overlap-sweep`` block into canonical
+    per-variant rows (None when the payload carries no sweep)."""
+    if not isinstance(sweep, Mapping):
+        return None
+    rows = []
+    for row in sweep.get("rows") or []:
+        if not isinstance(row, Mapping) or not row.get("variant"):
+            continue
+        rows.append({
+            "variant": str(row["variant"]),
+            "n_buckets": int(row.get("n_buckets") or 0),
+            "step_time_ms": _num(row.get("ms_per_step")),
+            "exposed_collective_seconds": _num(
+                row.get("exposed_collective_seconds")),
+            "achieved_overlap": _num(row.get("achieved_overlap")),
+            "overlap_by_class": _overlap_classes(
+                row.get("overlap_by_class")),
+        })
+    return rows or None
 
 
 def _sweep_rows(sweep: Any) -> Optional[list[dict[str, Any]]]:
@@ -412,6 +447,9 @@ def default_key(facts: Mapping[str, Any]) -> str:
         # the schedule sweep is its own workload: it must never be diffed
         # against the single-chip headline baseline (PC001 would fire)
         return f"{slug}_schedule_sweep"
+    if src == "bench" and w.get("metric") == "zero1_overlap_sweep":
+        # likewise the engineered-overlap sweep (bench.py --overlap-sweep)
+        return f"{slug}_overlap_sweep"
     return f"{slug}_{src}" if src != "bench" else f"{slug}_bench"
 
 
@@ -456,6 +494,7 @@ def calibration_findings(facts: Mapping[str, Any],
                      "observability')",
             )
     _sweep_findings(facts, noise, report)
+    _overlap_sweep_findings(facts, noise, report)
     measured = _num(facts.get("bubble_fraction_measured"))
     predicted = _num(facts.get("bubble_fraction_predicted"))
     if measured is None or predicted is None:
@@ -530,6 +569,71 @@ def _sweep_findings(facts: Mapping[str, Any], noise: Mapping[str, float],
                      "fill/drain win — check the m-major work-table "
                      "ordering and the per-kind cond gates",
             )
+
+
+def _overlap_sweep_findings(facts: Mapping[str, Any],
+                            noise: Mapping[str, float],
+                            report: AuditReport) -> None:
+    """Baseline-independent gates over ``bench.py --overlap-sweep`` rows.
+
+    PC203 — within one sweep run, the engineered configuration (multiple
+    buckets, i.e. a real prefetch-stagger chain) must EXPOSE at most the
+    monolithic (``off``) variant's collective seconds (within the
+    ``overlap_order_frac`` band, above the ``exposed_min_seconds`` floor):
+    overall AND per dp collective class.  Only rows with ``n_buckets > 1``
+    are gated: a single-bucket row has no stagger chain (nothing to
+    prefetch ahead of), so it carries no ordering claim — it is still
+    ratcheted row-by-row against the committed baseline (PC101/PC202 in
+    ``diff_facts``), just not ordered against ``off`` here.  This is the
+    engineered-overlap claim as a gate — bucketed ZeRO-1 regathers + the
+    prefetch stagger must not expose MORE wire time than the monolithic
+    gather they replace."""
+    rows = facts.get("overlap_sweep") or []
+    by_var = {str(r.get("variant")): r for r in rows
+              if isinstance(r, Mapping)}
+    off = by_var.get("off")
+    if not off:
+        return
+    band = float(noise.get("overlap_order_frac",
+                           DEFAULT_NOISE["overlap_order_frac"]))
+    floor = float(noise.get("exposed_min_seconds",
+                            DEFAULT_NOISE["exposed_min_seconds"]))
+
+    def gate(variant: str, label: str, a: Optional[float],
+             b: Optional[float]) -> None:
+        if a is None or b is None:
+            return
+        if b > a * (1.0 + band) and b - a > floor:
+            report.add(
+                "PC203", "error",
+                f"[overlap sweep] {variant}: exposed {label} collective "
+                f"seconds {_fmt(b)}s exceed monolithic {_fmt(a)}s x "
+                f"(1 + {band:g}) — bucketing exposes MORE wire time than "
+                f"the monolithic regather it replaces",
+                location=variant,
+                hint="optim/overlap.py bucketed_update owes each bucket's "
+                     "all-gather an overlap window (the prefetch barrier "
+                     "chain) and ONE combined collective per bucket — "
+                     "check the zero1-bucket class census in the graph "
+                     "contract and the bucket coalescing "
+                     "(zero1_bucket_mb)",
+            )
+
+    for variant, row in by_var.items():
+        if variant == "off" or not isinstance(row, Mapping):
+            continue
+        if int(row.get("n_buckets") or 0) <= 1:
+            continue
+        gate(variant, "total",
+             _num(off.get("exposed_collective_seconds")),
+             _num(row.get("exposed_collective_seconds")))
+        oc = _overlap_classes(off.get("overlap_by_class"))
+        nc = _overlap_classes(row.get("overlap_by_class"))
+        for kind in ("all-gather", "reduce-scatter"):
+            if kind in oc and kind in nc:
+                gate(variant, kind,
+                     _num(oc[kind].get("exposed_seconds")),
+                     _num(nc[kind].get("exposed_seconds")))
 
 
 def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
@@ -617,6 +721,58 @@ def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
                     "PC110", "info",
                     f"[schedule sweep] {sched} step time improved "
                     f"{_fmt(a, 2)}ms -> {_fmt(b, 2)}ms — tighten with "
+                    f"--update-baselines",
+                )
+
+    # -- PC101/PC202 per overlap-sweep row: step time + exposed seconds ----
+    o_rows = {r.get("variant"): r for r in old.get("overlap_sweep") or []
+              if isinstance(r, Mapping)}
+    n_rows = {r.get("variant"): r for r in new.get("overlap_sweep") or []
+              if isinstance(r, Mapping)}
+    for variant in sorted(set(o_rows) & set(n_rows)):
+        a = _num(o_rows[variant].get("step_time_ms"))
+        b = _num(n_rows[variant].get("step_time_ms"))
+        if a and b:
+            band = bands["step_time_frac"]
+            if b > a * (1.0 + band):
+                report.add(
+                    "PC101", "error",
+                    f"[overlap sweep] {variant} step time grew "
+                    f"{_fmt(a, 2)}ms -> {_fmt(b, 2)}ms "
+                    f"(+{100 * (b / a - 1):.0f}% > {100 * band:.0f}% noise "
+                    f"band)",
+                    location=variant,
+                    hint=_RATCHET_HINT,
+                )
+            elif b < a * (1.0 - band):
+                report.add(
+                    "PC110", "info",
+                    f"[overlap sweep] {variant} step time improved "
+                    f"{_fmt(a, 2)}ms -> {_fmt(b, 2)}ms — tighten with "
+                    f"--update-baselines",
+                )
+        a = _num(o_rows[variant].get("exposed_collective_seconds"))
+        b = _num(n_rows[variant].get("exposed_collective_seconds"))
+        if a is not None and b is not None:
+            band = bands["exposed_frac"]
+            floor = bands["exposed_min_seconds"]
+            if b > a * (1.0 + band) and b - a > floor:
+                report.add(
+                    "PC202", "error",
+                    f"[overlap sweep] {variant} exposed collective seconds "
+                    f"grew {_fmt(a)}s -> {_fmt(b)}s "
+                    f"(+{100 * (b / a - 1):.0f}% > {100 * band:.0f}% band)"
+                    if a > 0 else
+                    f"[overlap sweep] {variant} exposed collective seconds "
+                    f"appeared: {_fmt(a)}s -> {_fmt(b)}s",
+                    location=variant,
+                    hint=_RATCHET_HINT,
+                )
+            elif b < a * (1.0 - band) and a - b > floor:
+                report.add(
+                    "PC110", "info",
+                    f"[overlap sweep] {variant} exposed collective seconds "
+                    f"shrank {_fmt(a)}s -> {_fmt(b)}s — tighten with "
                     f"--update-baselines",
                 )
 
@@ -957,7 +1113,7 @@ def update_baseline(key: str, facts: Mapping[str, Any], *,
     old_just = list((snap or {}).get("justifications")
                     or ["initial perf baseline"])
     old_noise = dict((snap or {}).get("noise") or {})
-    bands = dict(DEFAULT_NOISE, **old_noise, **(noise or {}))
+    bands = {**DEFAULT_NOISE, **old_noise, **(noise or {})}
     if snap is None:
         rep = AuditReport(config=name)
         calibration_findings(facts, bands, rep)

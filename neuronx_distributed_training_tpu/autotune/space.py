@@ -147,6 +147,11 @@ class ModelFacts:
     lora: bool = False
     precision: Any = None            # raw precision block (cost model)
     declared: Optional[Plan] = None  # the config's own launch choice
+    # engineered overlap (distributed_strategy.overlap): the cost model
+    # prices the bucketed ZeRO-1 collective structure and lifts the dp
+    # hiding prior when the knobs are on
+    overlap_bucket_mb: float = 0.0
+    overlap_prefetch_ag: bool = True
 
     @classmethod
     def from_config(cls, cfg: Mapping) -> "ModelFacts":
@@ -237,6 +242,10 @@ class ModelFacts:
             alignment=alignment,
             lora=bool(dict(model.get("lora", {}) or {})),
             precision=cfg.get("precision", {}),
+            overlap_bucket_mb=float(
+                (ds.get("overlap") or {}).get("zero1_bucket_mb", 0.0) or 0.0),
+            overlap_prefetch_ag=bool(
+                (ds.get("overlap") or {}).get("prefetch_ag", True)),
         )
         declared = facts._declared_plan(ds, data, model)
         return dataclasses.replace(facts, declared=declared)

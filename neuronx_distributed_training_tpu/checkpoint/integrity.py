@@ -647,11 +647,19 @@ def inject_corruption(directory, step: int, kind: str, *,
         raise FileNotFoundError(f"no committed step {step} under {directory}")
 
     def data_files(root):
-        # prefer the OCDBT data payloads (".../d/<hash>") — flipping a byte
-        # there exercises the content-digest path, not just a parse error in
-        # a metadata file; fall back to any file (largest first)
-        files = [p for p in root.rglob("*")
-                 if p.is_file() and p.parent.name == "d"]
+        # prefer the OCDBT data payloads the manifest actually READS.  Newer
+        # orbax/tensorstore merges per-process writes into a top-level
+        # "<item>/d/<hash>" kvstore and restores through that; the
+        # "ocdbt.process_N/d/" copies become write-side staging, so damaging
+        # one is invisible to both restore and verification.  Older layouts
+        # keep the payloads only under the process dirs — fall back there,
+        # then to any file (largest first)
+        top = root / "d"
+        files = ([p for p in top.glob("*") if p.is_file()]
+                 if top.is_dir() else [])
+        if not files:
+            files = [p for p in root.rglob("*")
+                     if p.is_file() and p.parent.name == "d"]
         if not files:
             files = [p for p in root.rglob("*") if p.is_file()]
         files.sort(key=lambda p: p.stat().st_size, reverse=True)

@@ -252,6 +252,34 @@ def validate_config(cfg: ConfigDict) -> None:
             if blocked is not None:
                 raise ValueError(f"pipeline.schedule: {sched_knob}: {blocked}")
 
+    # ---- engineered overlap ----------------------------------------------
+    # distributed_strategy.overlap: {zero1_bucket_mb, prefetch_ag,
+    # pp_double_buffer, xla_lhs}.  Full validation (unknown-key did-you-mean,
+    # type checks) lives with the knobs' consumer in optim.overlap; rejecting
+    # here keeps the die-before-compile contract.
+    overlap_raw = ds.get("overlap")
+    if overlap_raw is not None:
+        from neuronx_distributed_training_tpu.optim.overlap import (
+            OverlapConfig,
+        )
+
+        ov = OverlapConfig.from_config(
+            dict(overlap_raw) if isinstance(overlap_raw, Mapping)
+            else overlap_raw
+        )
+        if ov.zero1_bucket_mb > 0 and ds.get("zero1", True) is False:
+            raise ValueError(
+                "distributed_strategy.overlap.zero1_bucket_mb > 0 requires "
+                "zero1: true — bucketing decomposes the ZeRO-1 collectives; "
+                "there is nothing to bucket without sharded optimizer state"
+            )
+        if ov.pp_double_buffer and pp <= 1:
+            raise ValueError(
+                "distributed_strategy.overlap.pp_double_buffer requires "
+                "pipeline_model_parallel_size > 1 (there are no stage hops "
+                "to double-buffer)"
+            )
+
     # ---- MoE --------------------------------------------------------------
     moe = model.get("moe", {}) or {}
     if moe.get("dropless") and (moe.get("capacity_factor") or 0) > 0:

@@ -177,6 +177,8 @@ def adamw_update(
     grad_group_fn: Optional[Callable] = None,
     skip_nonfinite: bool = False,
     extra_finite=None,
+    bucket_plan=None,
+    prefetch_ag: bool = True,
 ):
     """One AdamW step. Returns (new_params, new_opt_state, metrics).
 
@@ -200,7 +202,17 @@ def adamw_update(
       still counts as a skip).
 
     ``metrics["updates_finite"]`` (bool) is reported whenever any hook is
-    active."""
+    active.
+
+    ``bucket_plan`` (``optim.overlap.BucketPlan``): the engineered-overlap
+    path — the moment/master/param updates run per layer-group bucket with
+    one combined parameter all-gather per bucket (and, under
+    ``prefetch_ag``, an ``optimization_barrier`` chain staggering the
+    buckets so gather k overlaps update k+1).  Everything before (norms,
+    clipping) and after (EMA, skip select, metrics) is the shared
+    whole-tree code, and the per-bucket lambdas are the SAME ones the
+    monolithic path maps — numerics are bitwise identical; only the
+    collective structure changes."""
     policy = policy or DtypePolicy()
     step = opt_state["step"] + 1
     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
@@ -236,18 +248,15 @@ def adamw_update(
     master = opt_state.get("master", params)
     lr = jnp.asarray(lr, jnp.float32)
 
-    new_mu = jax.tree_util.tree_map(
-        lambda mu, g: b1 * mu.astype(jnp.float32) + (1 - b1) * g, opt_state["mu"], grads
-    )
-    new_nu = jax.tree_util.tree_map(
-        lambda nu, g: b2 * nu.astype(jnp.float32) + (1 - b2) * jnp.square(g),
-        opt_state["nu"],
-        grads,
-    )
-
     if trainable_mask is not None:
         # frozen params get no weight decay either
         masks = jax.tree_util.tree_map(lambda w, t: w * t, masks, trainable_mask)
+
+    def mu_fn(mu, g):
+        return b1 * mu.astype(jnp.float32) + (1 - b1) * g
+
+    def nu_fn(nu, g):
+        return b2 * nu.astype(jnp.float32) + (1 - b2) * jnp.square(g)
 
     def upd(m, mu, nu, wd_mask):
         mf = m.astype(jnp.float32)
@@ -255,7 +264,23 @@ def adamw_update(
         update = update + cfg.weight_decay * wd_mask * mf
         return mf - lr * update
 
-    new_master = jax.tree_util.tree_map(upd, master, new_mu, new_nu, masks)
+    if bucket_plan is not None and bucket_plan.buckets:
+        from neuronx_distributed_training_tpu.optim.overlap import (
+            bucketed_update,
+        )
+
+        new_mu, new_nu, new_master, new_params = bucketed_update(
+            bucket_plan, params, grads, opt_state["mu"], opt_state["nu"],
+            master, masks, mu_fn=mu_fn, nu_fn=nu_fn, upd_fn=upd,
+            prefetch=prefetch_ag,
+        )
+    else:
+        new_mu = jax.tree_util.tree_map(mu_fn, opt_state["mu"], grads)
+        new_nu = jax.tree_util.tree_map(nu_fn, opt_state["nu"], grads)
+        new_master = jax.tree_util.tree_map(upd, master, new_mu, new_nu, masks)
+        new_params = jax.tree_util.tree_map(
+            lambda x, p: x.astype(p.dtype), new_master, params
+        )
 
     odt = policy.optimizer_dtype
     new_state = {
@@ -277,7 +302,6 @@ def adamw_update(
                             + (1.0 - d) * p.astype(jnp.float32)).astype(odt),
             opt_state["ema"], new_master,
         )
-    new_params = jax.tree_util.tree_map(lambda x, p: x.astype(p.dtype), new_master, params)
     if skip_nonfinite:
         # in-graph skip: a select per leaf keeps params/moments/master/EMA AND
         # the step counter (bias correction must not advance on a skipped

@@ -1044,6 +1044,7 @@ def pipeline_loss_and_grad(
     shift_labels: bool = True,
     grad_dtype=jnp.float32,
     ignore_index: int = -100,
+    double_buffer: bool = False,
 ):
     """Manual-vjp pipeline step: returns ``(loss, grads)`` where ``grads``
     has exactly the keys ``{"layers", "params_from_embed", "head_params",
@@ -1077,6 +1078,14 @@ def pipeline_loss_and_grad(
     Loss matches ``pipeline_loss`` (same masking and normalization); the
     caller divides nothing — normalization by the global valid-token count is
     already inside.
+
+    ``double_buffer`` (``distributed_strategy.overlap.pp_double_buffer``)
+    moves both stage-hop collective-permutes out of their compute ``cond``s:
+    the forward hop issues after the F cond (overlapping the same tick's
+    head/backward compute) and the reverse hop defers to the next tick's
+    top, ahead of its first read (overlapping that tick's forward compute).
+    Gating/data paths are unchanged, so loss and grads are value-identical;
+    only the scheduler's freedom changes.
     """
     mesh = mesh or shd.active_mesh()
     pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
@@ -1133,7 +1142,7 @@ def pipeline_loss_and_grad(
         vp=vp, zero_bubble=zero_bubble, rings=table.ring_sizes,
         slots=slots, stage_aux=stage_aux, aux_scale=float(aux_scale),
         shift_labels=shift_labels, grad_dtype=grad_dtype,
-        ignore_index=ignore_index,
+        ignore_index=ignore_index, double_buffer=bool(double_buffer),
     )
     layer_spec = P(None, PIPE_AXIS) if vp > 1 else P(PIPE_AXIS)
     vocab_spec = P(PIPE_AXIS, *([None] * (head_weight.ndim - 1)))
@@ -1165,7 +1174,7 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
                  wt_rank, wt_glob, *,
                  stage_fn, head_hidden_fn, pp, nm, vp, zero_bubble, rings,
                  slots, stage_aux, aux_scale, shift_labels, grad_dtype,
-                 ignore_index):
+                 ignore_index, double_buffer=False):
     """Per-pipe-rank WORK-COMPACTED manual-vjp tick loop (inside shard_map,
     manual "pipe").
 
@@ -1257,6 +1266,21 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
         (recv, cot_recv, inflight, circ, bcirc, dy_ring, wdy_ring,
          d_layers, d_emb, d_w, d_hp_acc, loss_acc, aux_acc) = carry
 
+        if double_buffer:
+            # double-buffered reverse hop: ``cot_recv`` carries the UNHOPPED
+            # dgrad parked by the previous tick's b_block; it hops here at
+            # the tick top — gated on the table's shifted has_b column, the
+            # write->first-read interval the compacted schedule guarantees —
+            # so the collective-permute overlaps this tick's forward compute
+            # instead of serializing inside last tick's backward cond.  Its
+            # consumer (this tick's b_block / bcirc park) reads the hopped
+            # value exactly as the in-cond form did: value-identical.
+            cot_recv = jax.lax.cond(
+                xt["hop_b"],
+                lambda: jax.lax.ppermute(cot_recv, PIPE_AXIS, reverse),
+                lambda: cot_recv,
+            )
+
         # ---- chunk hand-off parks (values hopped at the previous tick) -
         # recv holds the predecessor's y from tick t-1: on rank 0 that is
         # the last rank's output, parked for its next chunk; cot_recv holds
@@ -1299,6 +1323,10 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
             y, s_aux = stage_flat(chunk_layers(c_F), x_in, mbF, c_F)
             # save the stage input for this rank's B (and zb wgrad) tick
             inflight = ring_put(inflight, xt["f_slot"], x_in, f_valid)
+            if double_buffer:
+                # hop hoisted out of this cond (issued below, after the
+                # cond) so it can overlap the head/backward compute
+                return y, s_aux, inflight, recv
             # forward ring hop: consumed by the successor's F next tick
             hop = jax.lax.ppermute(y, PIPE_AXIS, cyclic)
             return y, s_aux, inflight, hop
@@ -1309,6 +1337,16 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
                               jnp.zeros((), jnp.float32), inflight, recv),
             inflight,
         )
+        if double_buffer:
+            # hoisted forward hop: a cond branch is an atomic unit to XLA,
+            # so the in-cond permute serialized between this tick's stage
+            # compute and its head/backward blocks; standing alone it only
+            # depends on ``y`` and overlaps both
+            recv = jax.lax.cond(
+                xt["has_f"],
+                lambda: jax.lax.ppermute(y, PIPE_AXIS, cyclic),
+                lambda: recv,
+            )
         aux_acc = aux_acc + jnp.where(f_valid, s_aux, 0.0)
 
         # ---- head + CE (vocab sliced over pipe) ------------------------
@@ -1453,6 +1491,13 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
             mine = jnp.logical_and(xt["d0_valid"], xt["d0_dst"] == rank)
             d_emb = ring_put(d_emb, xt["d0_slot"],
                              routed.astype(grad_dtype), mine)
+            if double_buffer:
+                # park the dgrad unhopped; the deferred hop at the NEXT
+                # tick's top delivers it before its first read (the final
+                # tick's pending value has no consumer — the table would
+                # otherwise have scheduled another B — so never hopping it
+                # is safe)
+                return wdy_ring, d_layers, d_emb, d_x_masked
             # reverse ring hop: consumed by the predecessor's B next tick
             cot_hop = jax.lax.ppermute(d_x_masked, PIPE_AXIS, reverse)
             return wdy_ring, d_layers, d_emb, cot_hop
@@ -1513,6 +1558,12 @@ def _onef1b_body(local_layers, head_params, microbatches, w_r, emb, denom,
     # per-rank columns arrive [T, 1] (pipe-sharded on dim 1) -> [T]; the
     # scan consumes one row of the table per compacted tick
     xs = {**{k: v[:, 0] for k, v in wt_rank.items()}, **wt_glob}
+    if double_buffer:
+        # tick-uniform gate for the deferred reverse hop: "did the PREVIOUS
+        # tick run a backward" — has_b shifted one tick right (the pending
+        # dgrad parked at t-1 hops at the top of t)
+        hb = xs["has_b"]
+        xs["hop_b"] = jnp.concatenate([jnp.zeros((1,), hb.dtype), hb[:-1]])
     carry, _ = jax.lax.scan(tick, carry0, xs)
     (_, _, _, _, _, _, _, d_layers, d_emb, d_w, d_hp_acc, loss_acc,
      aux_acc) = carry

@@ -156,6 +156,34 @@ def main() -> None:
 
     cfg = load_config(args.config, overrides)
 
+    # -- engineered overlap env (distributed_strategy.overlap.xla_lhs): the
+    # latency-hiding-scheduler flag set merges into XLA_FLAGS BEFORE the
+    # backend initializes (first jax.devices() call below).  User-provided
+    # flags win; each dropped knob flag is warned, not silently last-wins.
+    from neuronx_distributed_training_tpu.optim.overlap import (
+        OverlapConfig,
+        merge_xla_flags,
+        xla_lhs_flags,
+    )
+
+    overlap_cfg = OverlapConfig.from_config(
+        (cfg.get("distributed_strategy", {}) or {}).get("overlap"))
+    if overlap_cfg.xla_lhs:
+        platform = args.platform or os.environ.get("JAX_PLATFORMS") or "tpu"
+        lhs = xla_lhs_flags(platform)
+        if not lhs:
+            logging.getLogger(__name__).warning(
+                "overlap.xla_lhs: no latency-hiding flag set for platform "
+                "%r — knob is a no-op (TPU only)", platform)
+        else:
+            merged, conflicts = merge_xla_flags(
+                os.environ.get("XLA_FLAGS", ""), lhs)
+            for name, keep, drop in conflicts:
+                logging.getLogger(__name__).warning(
+                    "overlap.xla_lhs: XLA_FLAGS already sets %s (%s); "
+                    "keeping yours, dropping knob flag %s", name, keep, drop)
+            os.environ["XLA_FLAGS"] = merged
+
     # -- elastic replan-on-resume (docs/elasticity.md): if a resumable
     # checkpoint's manifest names a different world size than the live fleet,
     # re-run the autotune planner on the NEW world size (filtered to

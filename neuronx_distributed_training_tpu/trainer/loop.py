@@ -339,6 +339,16 @@ class Trainer:
         devices = devices if devices is not None else jax.devices()
         mesh_cfg = MeshConfig.from_config(cfg.get("distributed_strategy", {}))
         mesh = build_mesh(mesh_cfg, devices=devices)
+        # engineered compute/comms overlap knobs (optim.overlap): bucketed
+        # ZeRO-1 collectives + double-buffered pipeline hops, both opt-in
+        from neuronx_distributed_training_tpu.optim.overlap import (
+            OverlapConfig,
+            build_bucket_plan,
+        )
+
+        overlap_cfg = OverlapConfig.from_config(
+            (cfg.get("distributed_strategy", {}) or {}).get("overlap")
+        )
         policy = DtypePolicy.from_precision_config(cfg.get("precision", {}))
         sched = batch_schedule(cfg, len(devices))
         seed = int(cfg.get("seed", 1234))
@@ -658,6 +668,7 @@ class Trainer:
                         zero_bubble=(pp_schedule == "1f1b-zb"),
                         stage_aux=stage_aux, aux_scale=aux_scale,
                         shift_labels=shift_labels,
+                        double_buffer=overlap_cfg.pp_double_buffer,
                     )
                     # assemble the params-shaped grad tree: start from the
                     # embed-path cotangent (zeros off the embed path), add
@@ -722,6 +733,19 @@ class Trainer:
             abstract_params, pspecs, mesh, zero1=zero1, policy=policy,
             ema=ema_cfg is not None, health=health_cfg.enabled,
         )
+        bucket_plan = None
+        if zero1 and overlap_cfg.zero1_bucket_mb > 0:
+            from neuronx_distributed_training_tpu.telemetry.health import (
+                grad_group_of,
+            )
+
+            bucket_plan = build_bucket_plan(
+                abstract_params, pspecs, ospecs["mu"], mesh,
+                bucket_mb=overlap_cfg.zero1_bucket_mb,
+                group_fn=grad_group_of,
+            )
+            if bucket_plan is not None:
+                logger.info("engineered overlap: %s", bucket_plan.describe())
 
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
         lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
@@ -738,6 +762,8 @@ class Trainer:
             param_specs=pspecs,
             loss_and_grad_fn=pp_loss_and_grad,
             health_cfg=health_cfg,
+            bucket_plan=bucket_plan,
+            prefetch_ag=overlap_cfg.prefetch_ag,
         )
         # NARROWED EMA workaround (round 3): donating an opt state that
         # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)

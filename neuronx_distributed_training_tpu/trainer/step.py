@@ -59,6 +59,8 @@ def make_train_step(
     param_specs: Any = None,  # pin grads to the param sharding (see below)
     loss_and_grad_fn: Optional[Callable] = None,  # manual-grad schedules (1F1B)
     health_cfg: Any = None,  # telemetry.health.HealthConfig (numerics probes)
+    bucket_plan: Any = None,  # optim.overlap.BucketPlan (engineered overlap)
+    prefetch_ag: bool = True,
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``.
@@ -156,6 +158,7 @@ def make_train_step(
             skip_nonfinite=(health is not None
                             and health.policy == "skip_update"),
             extra_finite=(jnp.isfinite(loss) if health is not None else None),
+            bucket_plan=bucket_plan, prefetch_ag=prefetch_ag,
         )
         metrics = {
             "loss": loss,
