@@ -442,7 +442,8 @@ def make_config(llama, on_tpu: bool, attn_impl: str, seq: int, layers: int | Non
 
 
 def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
-              num_microbatches: int = 1, trace: bool = False) -> dict:
+              num_microbatches: int = 1, trace: bool = False,
+              tensorstats: bool = False) -> dict:
     """One timed regime run; returns {ms_per_step, tokens_per_sec, mfu}.
 
     ``mbs`` is the TOTAL rows per step; ``num_microbatches > 1`` runs the
@@ -452,7 +453,11 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
     ``trace=True`` additionally captures a short device-time trace window
     AFTER the timed loop (so profiling overhead never contaminates
     ms_per_step) and reports measured achieved_overlap /
-    exposed_collective_seconds (telemetry.trace_analysis)."""
+    exposed_collective_seconds (telemetry.trace_analysis).
+    ``tensorstats=True`` rides the in-graph tensor-numerics plane
+    (telemetry.tensorstats) on the same compiled step and attaches a compact
+    per-collective-class quant-readiness summary to the JSON line (joined
+    with the trace's measured exposed seconds when ``trace`` is also on)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -487,9 +492,17 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
         # norm reduction would sit inside the timed loop skewing ms_per_step
         health = HealthConfig(enabled=True, policy="dump_and_continue",
                               param_norm=False)
-        opt_state = init_opt_state(params, policy, health=True)
+        ts_cfg = None
+        if tensorstats:
+            from neuronx_distributed_training_tpu.telemetry import (
+                TensorStatsConfig,
+            )
+
+            ts_cfg = TensorStatsConfig(enabled=True)
+        opt_state = init_opt_state(params, policy, health=True,
+                                   tensorstats=ts_cfg)
         ospecs = opt_state_specs(params, pspecs, mesh, zero1=True, policy=policy,
-                                 health=True)
+                                 health=True, tensorstats=ts_cfg)
         opt_state = put(opt_state, ospecs)
 
         def loss_fn(p, batch, step_key):
@@ -497,7 +510,8 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
 
         step = make_train_step(loss_fn, AdamWConfig(), constant_lr(1e-4), policy,
                                num_microbatches=num_microbatches,
-                               param_specs=pspecs, health_cfg=health)
+                               param_specs=pspecs, health_cfg=health,
+                               tensorstats_cfg=ts_cfg)
         jstep = jit_train_step(step, mesh, pspecs, ospecs)
 
         ids = jax.random.randint(
@@ -607,6 +621,52 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
                     f"exposed_collective_seconds="
                     f"{trace_summary.get('exposed_collective_seconds')}")
 
+        # quant-readiness: decode the streamed dynamic-range histograms
+        # (fetched AFTER the fence, outside the timed window) and simulate
+        # block-scaled int8 per collective class — compact enough to ride
+        # the JSON line; tools/quant_readiness.py renders the full report
+        quant_readiness = None
+        if ts_cfg is not None:
+            try:
+                import numpy as np
+
+                from neuronx_distributed_training_tpu.telemetry.quant_readiness import (  # noqa: E501
+                    build_report,
+                )
+                from neuronx_distributed_training_tpu.telemetry.tensorstats import (  # noqa: E501
+                    HIST_PREFIX, decode_cum,
+                )
+
+                groups = {
+                    k[len(HIST_PREFIX):]: decode_cum(
+                        np.asarray(v).tolist(), ts_cfg)
+                    for k, v in metrics.items() if k.startswith(HIST_PREFIX)
+                }
+                rep = build_report(
+                    {"step": steps, "groups": groups},
+                    overlap_by_class=(trace_summary or {}).get(
+                        "overlap_by_class"))
+                best = str(rep["block_sizes"][-1])
+                quant_readiness = {}
+                for kind in rep["ranking"]:
+                    e = rep["classes"][kind]
+                    if "pooled" not in e \
+                            and e.get("predicted_seconds_saved") is None:
+                        continue
+                    p = e.get("pooled", {}).get(best, {})
+                    quant_readiness[kind] = {
+                        "block_size": int(best),
+                        "sqnr_db": json_float(p.get("sqnr_db")),
+                        "rel_error_rms": json_float(
+                            p.get("rel_error_rms"), 9),
+                        "bytes_saved_frac": json_float(
+                            e.get("bytes_saved_frac"), 6),
+                        "predicted_seconds_saved": json_float(
+                            e.get("predicted_seconds_saved"), 9),
+                    }
+            except Exception as e:  # noqa: BLE001 — telemetry must not fail the bench
+                log(f"bench: quant-readiness summary unavailable: {e}")
+
     # measured peak HBM (telemetry.memory): the allocator's live watermark
     # after the timed loop when the backend reports one, else the compiled
     # memory_analysis() static estimate — the source is named so a reader
@@ -665,6 +725,10 @@ def run_bench(dev, cfg, policy, seq: int, mbs: int, steps: int, warmup: int,
         # coverage) for the measured executable
         "graph_audit": audit_summary,
     }
+    if quant_readiness is not None:
+        # compact per-collective-class compression verdict (--tensorstats):
+        # predicted SQNR / bytes saved at the largest simulated block size
+        out["quant_readiness"] = quant_readiness
     if trace_summary is not None:
         # measured device-time facts (--trace): the achieved-overlap signal
         # the autotune cost model calibrates against
@@ -1197,6 +1261,14 @@ def main() -> None:
                          "exposed_collective_seconds in the JSON line — "
                          "the signal the autotune cost model's comms term "
                          "calibrates against")
+    ap.add_argument("--tensorstats", action="store_true",
+                    help="ride the in-graph tensor-numerics plane "
+                         "(telemetry.tensorstats) on the bench step and "
+                         "emit a compact per-collective-class "
+                         "quant-readiness summary in the JSON line "
+                         "(predicted SQNR / bytes saved for block-scaled "
+                         "int8; combine with --trace to price the savings "
+                         "in measured exposed seconds)")
     ap.add_argument("--contract-key", default=None, metavar="NAME",
                     help="perf-contract baseline key override (default: "
                          "derived from the device identity, e.g. cpu_bench "
@@ -1432,7 +1504,7 @@ def main() -> None:
                 cfg = dataclasses.replace(cfg, num_layers=n_layers)
                 results[name] = run_bench(
                     dev, cfg, policy, seq, args.mbs, steps, warmup,
-                    trace=args.trace)
+                    trace=args.trace, tensorstats=args.tensorstats)
                 results[name]["tied_embeddings"] = tied
                 used_cfgs[name] = cfg
                 errors.pop(name, None)  # a successful backoff clears the record

@@ -112,6 +112,9 @@ def abstract_opt_state(asm: Any) -> Any:
         functools.partial(
             init_opt_state, policy=asm.policy, ema=asm.ema_cfg is not None,
             health=asm.health_cfg.enabled,
+            tensorstats=getattr(asm, "tensorstats_cfg", None),
+            tensorstats_bucket_groups=tuple(
+                getattr(asm, "tensorstats_bucket_groups", ())),
         ),
         asm.abstract_params,
     )

@@ -663,6 +663,80 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
 
 
 # --------------------------------------------------------------------------
+# per-collective byte volumes (telemetry.quant_readiness join)
+# --------------------------------------------------------------------------
+
+
+def collective_byte_volumes(facts: ModelFacts, plan: Plan
+                            ) -> dict[str, dict[str, float]]:
+    """Logical wire-byte volume per step, per axis, keyed by collective kind
+    (the ``AXIS_COLLECTIVE_KINDS`` vocabulary).
+
+    The SAME byte math as :func:`estimate_plan`, minus the time model: these
+    are the ``bytes_full`` arguments its ``_ring_seconds`` calls price, so a
+    compression study (``telemetry.quant_readiness``) can ask "how many bytes
+    does each collective class move?" without re-deriving the sharding
+    arithmetic.  Under SP the per-layer tp volume is an AG/RS pair — split
+    evenly between the two kinds; plain-TP all-reduces move the same wire
+    bytes, so the split stays an honest upper bound either way."""
+    policy = _policy_for(facts)
+    abytes = _dtype_bytes(policy.compute_dtype)
+    tokens_chip = facts.global_batch_size * facts.seq / (plan.dp * plan.cp)
+    h = facts.hidden
+    out: dict[str, dict[str, float]] = {}
+
+    if plan.tp > 1:
+        layer_total = 4.0 * tokens_chip * h * abytes \
+            * 2.0 * facts.num_layers / plan.pp
+        out["tp"] = {
+            "all-gather": layer_total / 2.0,
+            "reduce-scatter": layer_total / 2.0,
+            # vocab-parallel CE: two [tokens] f32 all-reduces per microbatch
+            "all-reduce": 2.0 * 2.0 * tokens_chip * 4.0,
+        }
+
+    if plan.dp > 1:
+        grad_bytes = params_per_device(facts, plan) \
+            * _dtype_bytes(policy.reduce_dtype)
+        if facts.zero1:
+            out["dp"] = {
+                "reduce-scatter": grad_bytes,
+                "all-gather": params_per_device(facts, plan)
+                * _dtype_bytes(policy.param_dtype),
+            }
+        else:
+            out["dp"] = {"all-reduce": grad_bytes}
+
+    if plan.pp > 1:
+        hop = plan.micro_batch_size * (facts.seq / plan.cp) * h * abytes
+        out["pp"] = {
+            "collective-permute": 2.0 * plan.num_microbatches * hop,
+        }
+
+    if plan.cp > 1:
+        if facts.cp_fusion == "ulysses":
+            out["cp"] = {
+                "all-to-all": 3.0 * facts.num_layers / plan.pp
+                * 2.0 * tokens_chip * h * abytes,
+            }
+        else:
+            out["cp"] = {
+                "collective-permute": 3.0 * facts.num_layers / plan.pp
+                * 2.0 * tokens_chip * facts.num_kv_heads * facts.head_dim
+                * abytes,
+            }
+
+    if plan.ep > 1 and facts.num_experts:
+        n_moe = facts.num_layers // max(facts.moe_frequency, 1)
+        out["ep"] = {
+            "all-to-all": 3.0 * n_moe / plan.pp
+            * tokens_chip * max(facts.top_k, 1) * h * abytes,
+        }
+
+    return out
+
+
+# --------------------------------------------------------------------------
 # rank agreement (bench.py --plan-topk)
 # --------------------------------------------------------------------------
 

@@ -118,10 +118,15 @@ def init_health_state():
 
 
 def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = False,
-                   health: bool = False):
+                   health: bool = False, tensorstats=None,
+                   tensorstats_bucket_groups: tuple = ()):
     """Opt state: step counter, fp32 moments, fp32 master weights when the
     params themselves are stored in a lower precision, (optionally) the
-    weight-EMA tree, and (optionally) the numerics-health counters."""
+    weight-EMA tree, (optionally) the numerics-health counters, and
+    (optionally) the tensor-numerics-observatory cumulative record
+    (``tensorstats`` — a ``telemetry.tensorstats.TensorStatsConfig``;
+    ``tensorstats_bucket_groups`` names the ZeRO-1 bucket slots when the
+    bucket phase is on)."""
     policy = policy or DtypePolicy()
     odt = policy.optimizer_dtype
 
@@ -139,6 +144,14 @@ def init_opt_state(params, policy: DtypePolicy | None = None, *, ema: bool = Fal
         state["ema"] = jax.tree_util.tree_map(lambda x: x.astype(odt), params)
     if health:
         state["health"] = init_health_state()
+    if tensorstats is not None and getattr(tensorstats, "enabled", False):
+        from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+            init_tensorstats_state,
+        )
+
+        state["tensorstats"] = init_tensorstats_state(
+            tensorstats, params,
+            bucket_groups=tuple(tensorstats_bucket_groups))
     return state
 
 
@@ -179,6 +192,7 @@ def adamw_update(
     extra_finite=None,
     bucket_plan=None,
     prefetch_ag: bool = True,
+    tensorstats_cfg=None,
 ):
     """One AdamW step. Returns (new_params, new_opt_state, metrics).
 
@@ -212,8 +226,27 @@ def adamw_update(
     clipping) and after (EMA, skip select, metrics) is the shared
     whole-tree code, and the per-bucket lambdas are the SAME ones the
     monolithic path maps — numerics are bitwise identical; only the
-    collective structure changes."""
+    collective structure changes.
+
+    ``tensorstats_cfg`` (``telemetry.tensorstats.TensorStatsConfig``,
+    enabled): the tensor numerics observatory — per layer-group absmax /
+    rms / zero / subnormal fraction / log2-exponent histogram of the grads
+    (pre- and post-clip, and of the packed ZeRO-1 bucket payloads under its
+    ``buckets`` phase), accumulated into ``opt_state["tensorstats"]``
+    (which ``init_opt_state(..., tensorstats=cfg)`` must have created) and
+    reported under ``metrics["tensorstats"]``.  The pre-clip rms reuses the
+    grouped squared sums that already derive the clipping norm.  A pure
+    observer: the update itself is bitwise-unchanged."""
     policy = policy or DtypePolicy()
+    tstats = (tensorstats_cfg
+              if tensorstats_cfg is not None
+              and getattr(tensorstats_cfg, "enabled", False) else None)
+    if tstats is not None and grad_group_fn is None:
+        from neuronx_distributed_training_tpu.telemetry.health import (
+            grad_group_of,
+        )
+
+        grad_group_fn = grad_group_of
     step = opt_state["step"] + 1
     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
     if trainable_mask is not None:
@@ -237,6 +270,7 @@ def adamw_update(
         if extra_finite is not None:
             updates_finite = jnp.logical_and(
                 updates_finite, jnp.asarray(extra_finite, bool))
+    grads_preclip = grads  # tensorstats pre-clip view (a reference, no copy)
     if cfg.grad_clip_norm is not None and cfg.grad_clip_norm > 0:
         clip = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-6))
         grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
@@ -264,6 +298,9 @@ def adamw_update(
         update = update + cfg.weight_decay * wd_mask * mf
         return mf - lr * update
 
+    packed_payloads = (
+        {} if (tstats is not None and tstats.buckets
+               and bucket_plan is not None and bucket_plan.buckets) else None)
     if bucket_plan is not None and bucket_plan.buckets:
         from neuronx_distributed_training_tpu.optim.overlap import (
             bucketed_update,
@@ -272,7 +309,7 @@ def adamw_update(
         new_mu, new_nu, new_master, new_params = bucketed_update(
             bucket_plan, params, grads, opt_state["mu"], opt_state["nu"],
             master, masks, mu_fn=mu_fn, nu_fn=nu_fn, upd_fn=upd,
-            prefetch=prefetch_ag,
+            prefetch=prefetch_ag, collect_packed=packed_payloads,
         )
     else:
         new_mu = jax.tree_util.tree_map(mu_fn, opt_state["mu"], grads)
@@ -302,6 +339,17 @@ def adamw_update(
                             + (1.0 - d) * p.astype(jnp.float32)).astype(odt),
             opt_state["ema"], new_master,
         )
+    ts_metrics = None
+    if tstats is not None:
+        from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+            tensorstats_update,
+        )
+
+        new_state["tensorstats"], ts_metrics = tensorstats_update(
+            opt_state["tensorstats"], tstats, group_fn=grad_group_fn,
+            grads_pre=grads_preclip, grads_post=grads, group_sq=group_sq,
+            packed=packed_payloads,
+        )
     if skip_nonfinite:
         # in-graph skip: a select per leaf keeps params/moments/master/EMA AND
         # the step counter (bias correction must not advance on a skipped
@@ -317,6 +365,8 @@ def adamw_update(
         metrics["updates_finite"] = updates_finite
     if group_sq is not None:
         metrics["group_norms"] = {k: jnp.sqrt(v) for k, v in group_sq.items()}
+    if ts_metrics is not None:
+        metrics["tensorstats"] = ts_metrics
     return new_params, new_state, metrics
 
 
@@ -358,7 +408,8 @@ def zero1_leaf_spec(spec: P, shape, mesh: Mesh, dp_axes=("data", "expert")) -> P
 def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
                     policy: DtypePolicy | None = None,
                     zero1_exclude: tuple = (), ema: bool = False,
-                    health: bool = False):
+                    health: bool = False, tensorstats=None,
+                    tensorstats_bucket_groups: tuple = ()):
     """Spec pytree matching ``init_opt_state`` output.
 
     ``zero1_exclude`` names path substrings whose moments keep the plain param
@@ -392,4 +443,12 @@ def opt_state_specs(params, param_specs, mesh: Mesh, *, zero1: bool = True,
         out["ema"] = moment_specs
     if health:
         out["health"] = {k: P() for k in HEALTH_STATE_KEYS}
+    if tensorstats is not None and getattr(tensorstats, "enabled", False):
+        from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+            tensorstats_state_specs,
+        )
+
+        out["tensorstats"] = tensorstats_state_specs(
+            tensorstats, params,
+            bucket_groups=tuple(tensorstats_bucket_groups))
     return out

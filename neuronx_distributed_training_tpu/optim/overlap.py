@@ -327,6 +327,7 @@ def bucketed_update(
     nu_fn: Callable,
     upd_fn: Callable,
     prefetch: bool = True,
+    collect_packed=None,
 ):
     """Per-bucket AdamW inner update with combined parameter all-gathers.
 
@@ -339,6 +340,12 @@ def bucketed_update(
     leaf.  With ``prefetch`` an ``optimization_barrier`` ties bucket k+1's
     grads to bucket k's pre-gather output, staggering the chain so gather k
     overlaps update k+1.
+
+    ``collect_packed`` (a dict, or None): when given, each bucket's packed
+    pre-gather ``[dp, cols]`` buffer is recorded under its bucket name — the
+    tensor numerics observatory (``telemetry.tensorstats``) reads the exact
+    payload the combined all-gather moves.  Purely observational: the traced
+    update itself is unchanged.
 
     Returns ``(new_mu, new_nu, new_master, new_params)`` as trees.
     """
@@ -384,6 +391,8 @@ def bucketed_update(
             packed = (jnp.concatenate(pieces, axis=1) if len(pieces) > 1
                       else pieces[0])
             packed = shd.constrain(packed, P(plan.dp_entry))
+            if collect_packed is not None:
+                collect_packed[bucket.name] = packed
             with jax.named_scope(BUCKET_AG_SCOPE):
                 gathered = shd.constrain(packed, P())
                 # the barrier pins the combined gather: without it XLA's

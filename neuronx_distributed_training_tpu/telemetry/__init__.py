@@ -57,6 +57,15 @@ from neuronx_distributed_training_tpu.telemetry.memory import (
     tree_bytes_by_subsystem,
 )
 from neuronx_distributed_training_tpu.telemetry.recompile import RecompileDetector
+from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+    HIST_PREFIX as TENSORSTATS_HIST_PREFIX,
+    SCALAR_PREFIX as TENSORSTATS_SCALAR_PREFIX,
+    TensorStatsConfig,
+    decode_cum,
+    init_tensorstats_state,
+    tensorstats_state_specs,
+    tensorstats_update,
+)
 from neuronx_distributed_training_tpu.telemetry.spans import (
     NON_PRODUCTIVE_SPANS,
     SpanTimer,
@@ -95,7 +104,10 @@ __all__ = [
     "RecompileDetector",
     "SpanTimer",
     "TELEMETRY_KNOBS",
+    "TENSORSTATS_HIST_PREFIX",
+    "TENSORSTATS_SCALAR_PREFIX",
     "TelemetryConfig",
+    "TensorStatsConfig",
     "TraceCapture",
     "TraceConfig",
     "aggregate_fleet",
@@ -103,8 +115,10 @@ __all__ = [
     "analyze_trace_dir",
     "attribute_profile",
     "compile_census",
+    "decode_cum",
     "device_memory_samples",
     "grad_group_of",
+    "init_tensorstats_state",
     "is_oom_error",
     "load_memory_summary",
     "load_trace_summary",
@@ -113,6 +127,8 @@ __all__ = [
     "parse_alerts",
     "parse_memory_profile",
     "pipeline_facts",
+    "tensorstats_state_specs",
+    "tensorstats_update",
     "trace_steps",
     "tree_bytes_by_subsystem",
 ]

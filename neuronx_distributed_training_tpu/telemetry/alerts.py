@@ -22,13 +22,20 @@ graph changes: the engine only ever sees host floats.
           - metric: loss
             below: 0.0               # fires when the windowed mean <= this
             action: log
+          - metric: tensorstats/pre/layers.attn/subnormal_frac
+            window: 5
+            rel_rise: 0.5            # fires when the windowed mean rises
+                                     # >= 50% above its own running minimum
+            action: dump
 
 Rule grammar (validated at config load — a typo'd rule dies there, not at
 step 10k): ``metric`` (required; matched against the logged metric keys,
 with a ``time/<metric>`` fallback so span rules read naturally), ``window``
 (>= 1 boundaries averaged), exactly ONE of ``threshold`` (fires high) /
 ``below`` (fires low) / ``rel_drop`` (fires on a relative drop vs the
-windowed mean's running peak — the "throughput fell off a cliff" form),
+windowed mean's running peak — the "throughput fell off a cliff" form) /
+``rel_rise`` (the mirror: fires on a relative rise vs the windowed mean's
+running MINIMUM — the "underflow fraction is creeping up" form),
 ``action`` (``log`` warns, ``dump`` writes a flight-recorder bundle
 ``alert_<step>/`` through the same machinery anomaly forensics use,
 ``halt`` requests a graceful stop whose reason lands in
@@ -60,7 +67,7 @@ ALERT_ACTIONS = ("log", "dump", "halt")
 MAX_FIRINGS_PER_RULE = 20
 
 _RULE_KEYS = {"name", "metric", "window", "threshold", "below", "rel_drop",
-              "action"}
+              "rel_rise", "action"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +77,7 @@ class AlertRule:
     threshold: Optional[float] = None
     below: Optional[float] = None
     rel_drop: Optional[float] = None
+    rel_rise: Optional[float] = None
     action: str = "log"
     name: str = ""
 
@@ -79,7 +87,9 @@ class AlertRule:
             return "threshold"
         if self.below is not None:
             return "below"
-        return "rel_drop"
+        if self.rel_drop is not None:
+            return "rel_drop"
+        return "rel_rise"
 
     @classmethod
     def from_config(cls, block: Any, index: int = 0) -> "AlertRule":
@@ -110,13 +120,14 @@ class AlertRule:
                 f"{where}.action must be one of {'/'.join(ALERT_ACTIONS)}, "
                 f"got {action!r}"
             )
-        modes = [k for k in ("threshold", "below", "rel_drop")
+        modes = [k for k in ("threshold", "below", "rel_drop", "rel_rise")
                  if block.get(k) is not None]
         if len(modes) != 1:
             raise ValueError(
                 f"{where} must set exactly ONE of threshold (fires high) / "
                 f"below (fires low) / rel_drop (fires on a relative drop vs "
-                f"the running peak); got {modes or 'none'}"
+                f"the running peak) / rel_rise (fires on a relative rise vs "
+                f"the running minimum); got {modes or 'none'}"
             )
         try:
             window = int(block.get("window", 1))
@@ -141,10 +152,18 @@ class AlertRule:
                 f"{where}.rel_drop must be a fraction in (0, 1], got "
                 f"{rel_drop}"
             )
+        # unlike rel_drop there is no upper bound: a metric can rise by more
+        # than 100% of its minimum (rel_rise: 3.0 = "quadrupled")
+        rel_rise = _f("rel_rise")
+        if rel_rise is not None and rel_rise <= 0.0:
+            raise ValueError(
+                f"{where}.rel_rise must be a positive fraction (0.5 = fires "
+                f"50% above the running minimum), got {rel_rise}"
+            )
         rule = cls(
             metric=metric, window=window, threshold=_f("threshold"),
-            below=_f("below"), rel_drop=rel_drop, action=action,
-            name=str(block.get("name", "") or ""),
+            below=_f("below"), rel_drop=rel_drop, rel_rise=rel_rise,
+            action=action, name=str(block.get("name", "") or ""),
         )
         if not rule.name:
             rule = dataclasses.replace(rule, name=f"{metric}_{rule.mode}")
@@ -166,7 +185,7 @@ def parse_alerts(block: Any) -> tuple[AlertRule, ...]:
             or not isinstance(block, Sequence):
         raise ValueError(
             f"exp_manager.telemetry.alerts must be a LIST of rule mappings "
-            f"(metric/window/threshold|below|rel_drop/action), got "
+            f"(metric/window/threshold|below|rel_drop|rel_rise/action), got "
             f"{type(block).__name__}"
         )
     rules = tuple(AlertRule.from_config(b, i) for i, b in enumerate(block))
@@ -199,6 +218,7 @@ class _RuleState:
         self.values: collections.deque = collections.deque(
             maxlen=rule.window)
         self.peak: Optional[float] = None  # running peak of windowed means
+        self.trough: Optional[float] = None  # running MINIMUM (rel_rise)
         self.active = False  # edge trigger: in-violation since last firing
         self.fired = 0
 
@@ -252,6 +272,12 @@ class AlertEngine:
                 # metric must not ratchet its own baseline down
                 if not violated and (st.peak is None or mean > st.peak):
                     st.peak = mean
+            elif rule.mode == "rel_rise":
+                # same discipline, mirrored: the trough only advances DOWN
+                # on clean windows — a spiked metric must not ratchet its
+                # own baseline up
+                if not violated and (st.trough is None or mean < st.trough):
+                    st.trough = mean
             if violated and not st.active:
                 st.active = True
                 st.fired += 1
@@ -290,11 +316,21 @@ class AlertEngine:
                 mean <= rule.below,
                 f"{rule.metric} = {mean:.6g}{w} <= floor {rule.below:.6g}",
             )
-        if st.peak is None or st.peak <= 0:
+        if rule.mode == "rel_drop":
+            if st.peak is None or st.peak <= 0:
+                return False, ""
+            floor = st.peak * (1.0 - rule.rel_drop)
+            return (
+                mean < floor,
+                f"{rule.metric} = {mean:.6g}{w} fell "
+                f"{100 * rule.rel_drop:.0f}% below its running peak "
+                f"{st.peak:.6g}",
+            )
+        if st.trough is None or st.trough <= 0:
             return False, ""
-        floor = st.peak * (1.0 - rule.rel_drop)
+        ceiling = st.trough * (1.0 + rule.rel_rise)
         return (
-            mean < floor,
-            f"{rule.metric} = {mean:.6g}{w} fell {100 * rule.rel_drop:.0f}% "
-            f"below its running peak {st.peak:.6g}",
+            mean > ceiling,
+            f"{rule.metric} = {mean:.6g}{w} rose {100 * rule.rel_rise:.0f}% "
+            f"above its running minimum {st.trough:.6g}",
         )

@@ -22,6 +22,10 @@ manager, and the config validator all agree on the schema:
         fleet:                # per-host beacons + aggregation (telemetry.fleet)
           enabled: false
           stale_after_seconds: 600
+        tensorstats:          # tensor numerics observatory (telemetry.tensorstats)
+          enabled: false
+          pre_clip: true
+          post_clip: true
         alerts:               # declarative alert rules (telemetry.alerts)
           - metric: data_wait
             threshold: 30.0
@@ -53,6 +57,9 @@ from neuronx_distributed_training_tpu.telemetry.alerts import (
 from neuronx_distributed_training_tpu.telemetry.fleet import FleetConfig
 from neuronx_distributed_training_tpu.telemetry.health import HealthConfig
 from neuronx_distributed_training_tpu.telemetry.memory import MemoryConfig
+from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+    TensorStatsConfig,
+)
 from neuronx_distributed_training_tpu.telemetry.trace import TraceConfig
 from neuronx_distributed_training_tpu.trainer.control import ControlConfig
 
@@ -79,7 +86,8 @@ TELEMETRY_KNOBS: dict[str, bool] = {
 }
 
 #: nested (non-boolean) telemetry blocks, each validated by its own parser
-_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts", "control", "memory")
+_NESTED_BLOCKS = ("health", "trace", "fleet", "alerts", "control", "memory",
+                  "tensorstats")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +107,13 @@ class TelemetryConfig:
     # device_memory_profile capture -> memory_summary.json, oom_<step>/
     # forensic bundles (docs/observability.md "Memory observability")
     memory: MemoryConfig = dataclasses.field(default_factory=MemoryConfig)
+    # tensor numerics observatory (telemetry.tensorstats): in-graph per
+    # layer-group dynamic-range stats for the optimizer-boundary grads —
+    # like health, the cumulative record lives in opt_state, so enabling it
+    # changes the checkpoint tree: an explicit opt-in
+    # (docs/observability.md "Tensor numerics observatory")
+    tensorstats: TensorStatsConfig = dataclasses.field(
+        default_factory=TensorStatsConfig)
     alerts: tuple[AlertRule, ...] = ()
     # coordinated fleet control (trainer.control): consensus stop decisions
     # via the boundary control word + the operator command channel
@@ -148,6 +163,9 @@ class TelemetryConfig:
                 continue
             if k == "memory":
                 values[k] = MemoryConfig.from_config(v)
+                continue
+            if k == "tensorstats":
+                values[k] = TensorStatsConfig.from_config(v)
                 continue
             if k == "fleet":
                 values[k] = FleetConfig.from_config(v)

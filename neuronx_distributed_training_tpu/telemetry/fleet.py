@@ -196,7 +196,8 @@ class FleetBeacon:
         picked: dict[str, float] = {}
         for k, v in (metrics or {}).items():
             if k in BEACON_METRICS or k.startswith("health/") \
-                    or k.startswith("data/") or k.startswith("memory/"):
+                    or k.startswith("data/") or k.startswith("memory/") \
+                    or k.startswith("tensorstats/"):
                 try:
                     f = float(v)
                 except (TypeError, ValueError):

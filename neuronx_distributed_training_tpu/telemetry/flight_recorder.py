@@ -148,6 +148,7 @@ class HealthMonitor:
             "metrics": {
                 k: v for k, v in metrics.items()
                 if k in _CORE_METRICS or k.startswith("health/")
+                or k.startswith("tensorstats/")
             },
         })
 

@@ -1,0 +1,428 @@
+"""``exp_manager.telemetry.tensorstats`` — the tensor numerics observatory.
+
+The trainer can see time (spans/traces), memory (``telemetry.memory``), and
+the fleet, but is blind to the *contents* of the tensors it moves.  This
+module is the in-graph half of that missing plane: per layer-group streaming
+dynamic-range statistics for the gradients at the optimizer boundary —
+absmax, rms, zero/subnormal fraction, and a compact log2-exponent histogram —
+computed INSIDE the one jitted train step (``optim.adamw.adamw_update(
+tensorstats_cfg=...)``), pre- and post-clip, and optionally for the packed
+ZeRO-1 bucket payloads of ``optim.overlap``.
+
+Discipline (shared with ``telemetry.health``):
+
+- the cumulative record lives in ``opt_state["tensorstats"]`` (one packed
+  f32 vector per phase x layer-group, see :data:`CUM_HEADER`), so it threads
+  step-to-step through the donated state, survives checkpoints, and reaches
+  the host for free inside the boundary metric fetch the loop already
+  performs — ZERO extra host syncs, zero extra executables;
+- the pre-clip rms reuses the per-group squared sums that already produce
+  the global clipping norm (``optim.adamw.grouped_sq_norms`` — one reduction
+  pass, one source of truth);
+- per-step scalars stream under ``tensorstats/<phase>/<group>/<stat>``
+  through every scalar sink (metrics.jsonl, the flight-recorder ring, fleet
+  beacons, alert rules); the cumulative histogram vectors stream under
+  ``tensorstats_hist/<phase>/<group>`` into the dedicated
+  ``tensorstats.jsonl`` (``ExpManager.log_tensorstats``) and the
+  ``tensorstats`` section of ``run_summary.json`` — NOT through the scalar
+  sinks (they are arrays).
+
+The harvested histograms are what ``telemetry.quant_readiness`` (and the
+``tools/quant_readiness.py`` CLI) turn into the block-scaled int8
+quantization-readiness report ROADMAP item 2 (EQuARX-style compressed
+collectives) prices itself from.
+
+Knob block (validated through ``TelemetryConfig.from_config`` at config
+load):
+
+.. code-block:: yaml
+
+    exp_manager:
+      telemetry:
+        tensorstats:
+          enabled: false
+          pre_clip: true       # grads at the optimizer boundary, pre-clip
+          post_clip: true      # same grads after global-norm clipping
+          buckets: false       # packed ZeRO-1 bucket payloads (needs
+                               # distributed_strategy.overlap bucketing)
+          hist_lo_exp: -24     # lowest log2-exponent histogram bin
+          hist_hi_exp: 8       # highest bin; edge bins absorb out-of-range
+
+Module import stays stdlib-only (the config parses on login nodes and in
+offline tools); jax is imported lazily inside the traced helpers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+#: per-step scalar metric prefix — every key under it is float-coercible and
+#: rides the ordinary scalar sinks (metrics.jsonl, ring, beacons, alerts)
+SCALAR_PREFIX = "tensorstats/"
+
+#: packed cumulative-vector metric prefix — array-valued, routed AROUND the
+#: scalar sinks into tensorstats.jsonl.  Deliberately NOT under
+#: ``tensorstats/`` so prefix filters on the scalar stream never admit it.
+HIST_PREFIX = "tensorstats_hist/"
+
+#: slot names of the packed cumulative vector, before the histogram bins:
+#: ``vec = [count, sumsq, absmax, zero, subnormal, hist_0 .. hist_{n-1}]``.
+#: count/sumsq/zero/subnormal accumulate across steps; absmax is a running
+#: max; hist accumulates per-bin counts of nonzero values by floor(log2|x|).
+CUM_HEADER = ("count", "sumsq", "absmax", "zero", "subnormal")
+
+#: phases a stat record can belong to
+PHASES = ("pre", "post", "bucket")
+
+_COUNT, _SUMSQ, _ABSMAX, _ZERO, _SUBNORMAL = range(len(CUM_HEADER))
+
+
+def _tensorstats_knobs() -> set[str]:
+    return {f.name for f in dataclasses.fields(TensorStatsConfig)}
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorStatsConfig:
+    enabled: bool = False
+    pre_clip: bool = True
+    post_clip: bool = True
+    buckets: bool = False
+    hist_lo_exp: int = -24
+    hist_hi_exp: int = 8
+
+    @property
+    def nbins(self) -> int:
+        return self.hist_hi_exp - self.hist_lo_exp + 1
+
+    @property
+    def vec_len(self) -> int:
+        return len(CUM_HEADER) + self.nbins
+
+    @classmethod
+    def from_config(cls, block: Any) -> "TensorStatsConfig":
+        """Parse (and validate) an ``exp_manager.telemetry.tensorstats``
+        block: ``None`` (defaults: disabled), a bare bool, or a mapping.
+        Unknown keys and out-of-range values raise ``ValueError``."""
+        if block is None:
+            return cls()
+        if isinstance(block, bool):
+            return cls(enabled=block)
+        knobs = _tensorstats_knobs()
+        if not isinstance(block, Mapping):
+            raise ValueError(
+                f"exp_manager.telemetry.tensorstats must be a mapping of "
+                f"{sorted(knobs)} (or a single bool), got "
+                f"{type(block).__name__}"
+            )
+        unknown = set(block) - knobs
+        if unknown:
+            from neuronx_distributed_training_tpu.config.loader import (
+                did_you_mean,
+            )
+
+            raise ValueError(
+                f"unknown exp_manager.telemetry.tensorstats keys "
+                f"{sorted(unknown)}; supported: {sorted(knobs)}"
+                + did_you_mean(unknown, knobs)
+            )
+        values = dict(block)
+        for key in ("enabled", "pre_clip", "post_clip", "buckets"):
+            if key in values and not isinstance(values[key], bool):
+                raise ValueError(
+                    f"exp_manager.telemetry.tensorstats.{key} must be a "
+                    f"boolean, got {values[key]!r}"
+                )
+        for key in ("hist_lo_exp", "hist_hi_exp"):
+            v = values.get(key)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, int)):
+                raise ValueError(
+                    f"exp_manager.telemetry.tensorstats.{key} must be an "
+                    f"integer log2 exponent, got {values[key]!r}"
+                )
+        out = cls(
+            enabled=bool(values.get("enabled", cls.enabled)),
+            pre_clip=bool(values.get("pre_clip", cls.pre_clip)),
+            post_clip=bool(values.get("post_clip", cls.post_clip)),
+            buckets=bool(values.get("buckets", cls.buckets)),
+            hist_lo_exp=int(values.get("hist_lo_exp", cls.hist_lo_exp)),
+            hist_hi_exp=int(values.get("hist_hi_exp", cls.hist_hi_exp)),
+        )
+        if out.hist_hi_exp <= out.hist_lo_exp:
+            raise ValueError(
+                f"exp_manager.telemetry.tensorstats.hist_hi_exp "
+                f"({out.hist_hi_exp}) must be > hist_lo_exp "
+                f"({out.hist_lo_exp})"
+            )
+        if out.nbins > 256:
+            raise ValueError(
+                f"exp_manager.telemetry.tensorstats histogram spans "
+                f"{out.nbins} bins ({out.hist_lo_exp}..{out.hist_hi_exp}); "
+                f"cap is 256 — the point is a COMPACT record"
+            )
+        if out.enabled and not (out.pre_clip or out.post_clip or out.buckets):
+            raise ValueError(
+                "exp_manager.telemetry.tensorstats is enabled but every "
+                "phase (pre_clip/post_clip/buckets) is off — nothing to "
+                "record; disable it instead"
+            )
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# state layout (opt_state["tensorstats"])
+# ---------------------------------------------------------------------------
+
+
+def state_key(phase: str, group: str) -> str:
+    """State-dict key for one phase x layer-group cumulative vector.  Group
+    names carry ``/`` (``layers/attn``) which checkpoint path-naming must not
+    see — state keys use ``.`` (``pre.layers.attn``); metric keys keep the
+    ``/`` spelling."""
+    return f"{phase}.{group.replace('/', '.')}"
+
+
+def split_state_key(key: str) -> tuple[str, str]:
+    """Inverse of :func:`state_key` -> ``(phase, group)`` with ``/`` groups."""
+    phase, _, rest = key.partition(".")
+    return phase, rest.replace(".", "/")
+
+
+def param_groups(params: Any, group_fn: Optional[Callable] = None
+                 ) -> tuple[str, ...]:
+    """Sorted layer-group names of a (possibly abstract) params tree under
+    ``group_fn`` (default: ``telemetry.health.grad_group_of``)."""
+    import jax
+
+    if group_fn is None:
+        from neuronx_distributed_training_tpu.telemetry.health import (
+            grad_group_of,
+        )
+
+        group_fn = grad_group_of
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return tuple(sorted({group_fn(path) for path, _ in leaves}))
+
+
+def state_keys(cfg: TensorStatsConfig, groups: Sequence[str],
+               bucket_groups: Sequence[str] = ()) -> tuple[str, ...]:
+    keys: list[str] = []
+    if cfg.pre_clip:
+        keys += [state_key("pre", g) for g in groups]
+    if cfg.post_clip:
+        keys += [state_key("post", g) for g in groups]
+    if cfg.buckets:
+        keys += [state_key("bucket", g) for g in bucket_groups]
+    return tuple(keys)
+
+
+def init_tensorstats_state(cfg: TensorStatsConfig, params: Any = None, *,
+                           groups: Optional[Sequence[str]] = None,
+                           bucket_groups: Sequence[str] = ()) -> dict:
+    """Fresh cumulative state: a zero packed vector per phase x group plus a
+    ``steps`` counter.  ``params`` may be abstract (shapes only)."""
+    import jax.numpy as jnp
+
+    if groups is None:
+        groups = param_groups(params)
+    state: dict[str, Any] = {"steps": jnp.zeros((), jnp.int32)}
+    for k in state_keys(cfg, groups, bucket_groups):
+        state[k] = jnp.zeros((cfg.vec_len,), jnp.float32)
+    return state
+
+
+def tensorstats_state_specs(cfg: TensorStatsConfig, params: Any = None, *,
+                            groups: Optional[Sequence[str]] = None,
+                            bucket_groups: Sequence[str] = ()) -> dict:
+    """Sharding specs mirroring :func:`init_tensorstats_state` — everything
+    replicated (the vectors are tiny)."""
+    from jax.sharding import PartitionSpec as P
+
+    if groups is None:
+        groups = param_groups(params)
+    specs: dict[str, Any] = {"steps": P()}
+    for k in state_keys(cfg, groups, bucket_groups):
+        specs[k] = P()
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# in-graph statistics (traced — called from optim.adamw.adamw_update)
+# ---------------------------------------------------------------------------
+
+
+def leaf_stats_vec(x: Any, cfg: TensorStatsConfig) -> Any:
+    """This-step packed stats vector of ONE array (see :data:`CUM_HEADER`).
+
+    Non-finite values: NaN joins neither the zero count nor the histogram
+    (``|x| > 0`` is False for NaN) but poisons absmax/sumsq — honest for the
+    per-step trajectory; the cumulative merge sanitizes (:func:`merge_cum`).
+    +/-inf lands in the top histogram bin.  Subnormal means
+    ``0 < |x| < finfo(float32).tiny`` — the stats run on the f32 grads at
+    the optimizer boundary."""
+    import jax.numpy as jnp
+
+    # stats are computed on the array's NATIVE shape: a reshape(-1) of a
+    # sharded input (e.g. the [dp, cols] packed ZeRO-1 bucket payload) would
+    # make GSPMD insert an all-to-all reshard just to observe it — the whole
+    # reduction below is shape-agnostic
+    x = jnp.asarray(x, jnp.float32)
+    ax = jnp.abs(x)
+    nz = ax > 0
+    nzf = nz.astype(jnp.float32)
+    absmax = jnp.max(ax)
+    sumsq = jnp.sum(x * x)
+    zero = jnp.sum((ax == 0).astype(jnp.float32))
+    tiny = jnp.float32(jnp.finfo(jnp.float32).tiny)
+    subnormal = jnp.sum((nz & (ax < tiny)).astype(jnp.float32))
+    # log2-exponent histogram of the nonzero values: bin i counts values with
+    # floor(log2|x|) == hist_lo_exp + i; the edge bins absorb out-of-range.
+    # NOTE the scatter-add's partitioner prefers replicated updates, a
+    # preference that propagates BACKWARD into the grad producers — the
+    # grad-accumulation carry is sharding-pinned in trainer/step.py so it
+    # cannot tip the loop-carry layout (a broadcast-compare-reduce binning
+    # has no such preference but materializes an nbins-times-larger temp)
+    e = jnp.floor(jnp.log2(jnp.where(nz, ax, jnp.float32(1.0))))
+    idx = jnp.clip(e - cfg.hist_lo_exp, 0, cfg.nbins - 1).astype(jnp.int32)
+    hist = jnp.zeros((cfg.nbins,), jnp.float32).at[idx].add(nzf)
+    head = jnp.stack([jnp.float32(x.size), sumsq, absmax, zero,
+                      subnormal])
+    return jnp.concatenate([head, hist])
+
+
+def merge_step_vecs(a: Any, b: Any) -> Any:
+    """Combine two this-step vectors (sum slots add, absmax slot maxes)."""
+    import jax.numpy as jnp
+
+    s = a + b
+    return s.at[_ABSMAX].set(jnp.maximum(a[_ABSMAX], b[_ABSMAX]))
+
+
+def group_step_vectors(tree: Any, group_fn: Callable,
+                       cfg: TensorStatsConfig, *,
+                       group_sq: Optional[Mapping[str, Any]] = None) -> dict:
+    """Per layer-group this-step vectors over a grads tree.  ``group_sq`` —
+    the per-group squared sums ``optim.adamw.grouped_sq_norms`` already
+    computed for the clipping norm — replaces the sumsq slot so the rms
+    shares that one reduction pass instead of adding its own."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: dict[str, Any] = {}
+    for path, leaf in leaves:
+        g = group_fn(path)
+        v = leaf_stats_vec(leaf, cfg)
+        out[g] = v if g not in out else merge_step_vecs(out[g], v)
+    if group_sq is not None:
+        for g, sq in group_sq.items():
+            if g in out:
+                out[g] = out[g].at[_SUMSQ].set(sq)
+    return out
+
+
+def merge_cum(cum: Any, step_vec: Any) -> Any:
+    """Fold one this-step vector into the cumulative record.  Non-finite
+    step contributions are dropped (a single NaN step must not poison the
+    whole run's distribution — the per-step scalars still show it)."""
+    import jax.numpy as jnp
+
+    safe = jnp.where(jnp.isfinite(step_vec), step_vec, jnp.float32(0.0))
+    new = cum + safe
+    return new.at[_ABSMAX].set(jnp.maximum(cum[_ABSMAX], safe[_ABSMAX]))
+
+
+def step_scalar_metrics(phase: str, group: str, vec: Any) -> dict:
+    """Per-step float-coercible metrics of one this-step vector."""
+    import jax.numpy as jnp
+
+    n = jnp.maximum(vec[_COUNT], jnp.float32(1.0))
+    base = f"{SCALAR_PREFIX}{phase}/{group}"
+    return {
+        f"{base}/absmax": vec[_ABSMAX],
+        f"{base}/rms": jnp.sqrt(vec[_SUMSQ] / n),
+        f"{base}/zero_frac": vec[_ZERO] / n,
+        f"{base}/subnormal_frac": vec[_SUBNORMAL] / n,
+    }
+
+
+def tensorstats_update(
+    prev_state: Mapping[str, Any],
+    cfg: TensorStatsConfig,
+    *,
+    group_fn: Optional[Callable] = None,
+    grads_pre: Any = None,
+    grads_post: Any = None,
+    group_sq: Optional[Mapping[str, Any]] = None,
+    packed: Optional[Mapping[str, Any]] = None,
+) -> tuple[dict, dict]:
+    """One traced step of the observatory.
+
+    Returns ``(new_state, metrics)``: the updated cumulative state (same
+    tree structure as ``prev_state``) and the boundary metrics — per-step
+    scalars under :data:`SCALAR_PREFIX` plus the cumulative packed vectors
+    under :data:`HIST_PREFIX`.  ``packed`` maps bucket name -> the packed
+    ``[dp, cols]`` ZeRO-1 payload buffer."""
+    new_state = dict(prev_state)
+    new_state["steps"] = prev_state["steps"] + 1
+    metrics: dict[str, Any] = {}
+
+    def fold(phase: str, vectors: Mapping[str, Any]) -> None:
+        for g, sv in vectors.items():
+            key = state_key(phase, g)
+            if key not in prev_state:
+                raise KeyError(
+                    f"tensorstats state has no slot {key!r} — init_opt_state "
+                    f"and adamw_update disagree on the layer groups"
+                )
+            cum = merge_cum(prev_state[key], sv)
+            new_state[key] = cum
+            metrics.update(step_scalar_metrics(phase, g, sv))
+            metrics[f"{HIST_PREFIX}{phase}/{g}"] = cum
+
+    if cfg.pre_clip and grads_pre is not None:
+        fold("pre", group_step_vectors(grads_pre, group_fn, cfg,
+                                       group_sq=group_sq))
+    if cfg.post_clip and grads_post is not None:
+        fold("post", group_step_vectors(grads_post, group_fn, cfg))
+    if cfg.buckets and packed:
+        fold("bucket", {name: leaf_stats_vec(buf, cfg)
+                        for name, buf in packed.items()})
+    return new_state, metrics
+
+
+# ---------------------------------------------------------------------------
+# host-side decode (boundary fetch -> tensorstats.jsonl / run_summary)
+# ---------------------------------------------------------------------------
+
+
+def decode_cum(vec: Any, cfg_or_lo: Any) -> dict:
+    """Decode one fetched packed cumulative vector into the JSON record the
+    ``tensorstats.jsonl`` stream and ``run_summary.json`` carry.  Accepts a
+    :class:`TensorStatsConfig` or a bare ``hist_lo_exp`` int (the histogram
+    length is self-describing).  Stdlib-only — numpy arrays arrive as any
+    float-indexable sequence."""
+    vals = [float(v) for v in vec]
+    head = vals[:len(CUM_HEADER)]
+    hist = vals[len(CUM_HEADER):]
+    lo = (cfg_or_lo.hist_lo_exp if hasattr(cfg_or_lo, "hist_lo_exp")
+          else int(cfg_or_lo))
+    count = head[_COUNT]
+    rec = {
+        "count": count,
+        "sumsq": head[_SUMSQ],
+        "absmax": head[_ABSMAX],
+        "zero": head[_ZERO],
+        "subnormal": head[_SUBNORMAL],
+        "rms": math.sqrt(head[_SUMSQ] / count) if count > 0 else 0.0,
+        "zero_frac": head[_ZERO] / count if count > 0 else 0.0,
+        "subnormal_frac": head[_SUBNORMAL] / count if count > 0 else 0.0,
+        "hist_lo_exp": lo,
+        "hist_hi_exp": lo + len(hist) - 1,
+        "hist": [int(h) for h in hist],
+    }
+    return rec

@@ -114,6 +114,12 @@ class ExpManager:
         self._last_tput: Optional[float] = None
         self._last_step_time: Optional[float] = None
         self._metrics_file = self.log_dir / "metrics.jsonl"
+        # structured tensorstats records (histogram vectors — NOT scalars)
+        # stream here, next to metrics.jsonl; see log_tensorstats
+        self._tensorstats_file = self.log_dir / "tensorstats.jsonl"
+        #: newest decoded tensorstats record — the loop teardown persists it
+        #: as the run_summary.json "tensorstats" section
+        self.last_tensorstats: Optional[dict] = None
         self._run_summary_file = self.log_dir / "run_summary.json"
         # run_summary.json is a read-modify-write merge reached from the main
         # thread (census, goodput teardown) AND, when the hang watchdog fires
@@ -395,9 +401,17 @@ class ExpManager:
         if not force and step % self.log_every_n_steps != 0:
             return
         flat: dict[str, float] = {}
+        stray_tensorstats: dict[str, Any] = {}
         for k, v in metrics.items():
             f = _coerce_scalar(v)
             if f is None:
+                if k.startswith("tensorstats"):
+                    # a tensorstats histogram vector that reached the scalar
+                    # path (a caller that didn't pre-split the boundary
+                    # fetch): route it to its own stream instead of the
+                    # warn-once drop — the payload is structured BY DESIGN
+                    stray_tensorstats[k] = v
+                    continue
                 if k not in self._warned_nonscalar:
                     self._warned_nonscalar.add(k)
                     shape = getattr(v, "shape", None)
@@ -411,6 +425,8 @@ class ExpManager:
                     )
                 continue
             flat[k] = f
+        if stray_tensorstats:
+            self.log_tensorstats(step, stray_tensorstats)
         if self._last_tput is not None:
             flat["throughput_seqs_per_sec"] = self._last_tput
             flat["throughput_peak"] = self.throughput.peak
@@ -430,6 +446,49 @@ class ExpManager:
             self._mlflow.log_metrics(flat, step=step)
         with open(self._metrics_file, "a") as f:
             f.write(json.dumps({"step": step, **flat}) + "\n")
+
+    def log_tensorstats(self, step: int, payload: dict[str, Any]) -> None:
+        """Append one structured tensor-numerics-observatory record to
+        ``tensorstats.jsonl``.
+
+        ``payload`` maps ``tensorstats_hist/<phase>/<group>`` metric keys to
+        the packed cumulative vectors fetched at the boundary (numpy arrays
+        or float sequences — see ``telemetry.tensorstats.CUM_HEADER``).
+        These are ARRAYS: they must never reach the scalar sinks, so they
+        get their own strict-JSON stream (one decoded record per boundary)
+        plus ``self.last_tensorstats`` for the run_summary teardown
+        section.  Keys without the hist prefix are ignored (defensive: the
+        caller may hand over a mixed dict)."""
+        from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+            HIST_PREFIX,
+            decode_cum,
+        )
+
+        cfg = self.telemetry.tensorstats
+        groups: dict[str, Any] = {}
+        for k, v in payload.items():
+            if not k.startswith(HIST_PREFIX):
+                continue
+            try:
+                groups[k[len(HIST_PREFIX):]] = decode_cum(v, cfg)
+            except (TypeError, ValueError) as e:
+                logger.warning(
+                    "log_tensorstats: undecodable payload for %r: %s", k, e)
+        if not groups:
+            return
+        rec = {
+            "step": int(step),
+            "hist_lo_exp": cfg.hist_lo_exp,
+            "hist_hi_exp": cfg.hist_hi_exp,
+            "groups": groups,
+        }
+        self.last_tensorstats = rec
+        try:
+            with open(self._tensorstats_file, "a") as f:
+                f.write(json.dumps(rec, allow_nan=False) + "\n")
+        except (OSError, ValueError, TypeError) as e:
+            # observability must not kill training
+            logger.warning("tensorstats.jsonl write failed: %s", e)
 
     def close(self) -> None:
         if self._profiling:

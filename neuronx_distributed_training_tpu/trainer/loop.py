@@ -222,6 +222,8 @@ class StepProgram:
     opt_cfg: Any
     ema_cfg: Optional[Any]
     health_cfg: Any
+    tensorstats_cfg: Any
+    tensorstats_bucket_groups: tuple
     trainable: Any
     lora_block: dict
     jstep: Callable
@@ -711,15 +713,18 @@ class Trainer:
         ema_cfg = (
             EMAConfig.from_config(ema_block) if ema_block.get("enable") else None
         )
-        # numerics flight recorder (telemetry.health): parsed here — before the
-        # optimizer state exists — because enabling it adds the health-counter
-        # subtree to opt_state (and therefore to its specs and checkpoints);
+        # numerics flight recorder (telemetry.health) + tensor numerics
+        # observatory (telemetry.tensorstats): parsed here — before the
+        # optimizer state exists — because enabling either adds its subtree
+        # to opt_state (and therefore to its specs and checkpoints);
         # ExpManager re-parses the same block for the host-side knobs
         from neuronx_distributed_training_tpu.telemetry import TelemetryConfig
 
-        health_cfg = TelemetryConfig.from_config(
+        _tel_cfg = TelemetryConfig.from_config(
             (cfg.get("exp_manager", {}) or {}).get("telemetry")
-        ).health
+        )
+        health_cfg = _tel_cfg.health
+        tensorstats_cfg = _tel_cfg.tensorstats
         abstract_params = jax.eval_shape(param_builder, init_key)
         if trainable is None and lora_block:
             # path-derived 0/1 scalars; reuses the one abstract trace
@@ -747,6 +752,22 @@ class Trainer:
             if bucket_plan is not None:
                 logger.info("engineered overlap: %s", bucket_plan.describe())
 
+        # tensorstats slots join the opt-state specs AFTER bucket planning:
+        # the bucket phase records the packed payload of each combined
+        # all-gather, so its state slots are named by the plan's buckets
+        ts_bucket_groups: tuple = ()
+        if tensorstats_cfg.enabled:
+            from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+                tensorstats_state_specs,
+            )
+
+            if tensorstats_cfg.buckets and bucket_plan is not None:
+                ts_bucket_groups = tuple(
+                    b.name for b in bucket_plan.buckets if b.ag)
+            ospecs["tensorstats"] = tensorstats_state_specs(
+                tensorstats_cfg, abstract_params,
+                bucket_groups=ts_bucket_groups)
+
         max_steps = int((cfg.get("trainer", {}) or {}).get("max_steps", 100))
         lr_schedule = build_lr_schedule(opt_block, max_steps_default=max_steps)
         exp_block = dict(cfg.get("exp_manager", {}) or {})
@@ -764,6 +785,7 @@ class Trainer:
             health_cfg=health_cfg,
             bucket_plan=bucket_plan,
             prefetch_ag=overlap_cfg.prefetch_ag,
+            tensorstats_cfg=tensorstats_cfg,
         )
         # NARROWED EMA workaround (round 3): donating an opt state that
         # carries the EMA tree trips an INVALID_ARGUMENT in the (tunnelled)
@@ -785,7 +807,9 @@ class Trainer:
             forward_logits=forward_logits, param_builder=param_builder,
             init_key=init_key, abstract_params=abstract_params,
             pspecs=pspecs, ospecs=ospecs, opt_cfg=opt_cfg, ema_cfg=ema_cfg,
-            health_cfg=health_cfg, trainable=trainable, lora_block=lora_block,
+            health_cfg=health_cfg, tensorstats_cfg=tensorstats_cfg,
+            tensorstats_bucket_groups=ts_bucket_groups,
+            trainable=trainable, lora_block=lora_block,
             jstep=jstep, eval_fn=eval_fn, data_module=data_module,
             val_data_module=val_data_module, shift_labels=shift_labels,
             pipeline_schedule=pp_schedule, num_micro_in_step=num_micro_in_step,
@@ -846,9 +870,12 @@ class Trainer:
 
         with mesh, shd.use_mesh(mesh):
             opt_state = jax.jit(
-                functools.partial(init_opt_state, policy=policy,
-                                  ema=ema_cfg is not None,
-                                  health=health_cfg.enabled),
+                functools.partial(
+                    init_opt_state, policy=policy,
+                    ema=ema_cfg is not None,
+                    health=health_cfg.enabled,
+                    tensorstats=asm.tensorstats_cfg,
+                    tensorstats_bucket_groups=asm.tensorstats_bucket_groups),
                 out_shardings=shardings(ospecs),
             )(params)
 
@@ -1164,43 +1191,66 @@ class Trainer:
                 opt_specs=self.opt_specs,
             )
         except Exception as orig:
-            if "health" not in self.opt_state:
+            # enabling telemetry.health or telemetry.tensorstats adds a
+            # subtree to the opt state, so a checkpoint written BEFORE the
+            # knob was turned on mismatches the template: retry without the
+            # newer subtree(s) and keep the freshly initialized (already
+            # correctly sharded) counters — an operator flipping a telemetry
+            # knob on must not lose their run.  Candidates are tried
+            # narrowest-first (newest feature alone, then each alone, then
+            # both) so a checkpoint that DOES carry one subtree keeps it.  A
+            # retry chain that fails too re-raises the ORIGINAL error (the
+            # real root cause), not a retry's.
+            telemetry_subtrees = [k for k in ("tensorstats", "health")
+                                  if k in self.opt_state]
+            if not telemetry_subtrees:
                 raise
-            # enabling telemetry.health adds a subtree to the opt state, so a
-            # checkpoint written BEFORE the knob was turned on mismatches the
-            # template: retry without the health subtree and keep the freshly
-            # initialized (already correctly sharded) counters — an operator
-            # flipping health on must not lose their run.  A retry that fails
-            # too re-raises the ORIGINAL error (the real root cause), not the
-            # retry's.
-            logger.warning(
-                "resume: full restore failed (%s: %s); retrying without the "
-                "telemetry.health subtree in case the checkpoint predates it",
-                type(orig).__name__, orig,
-            )
-            stripped = {k: v for k, v in self.opt_state.items()
-                        if k != "health"}
-            stripped_specs = {k: v for k, v in self.opt_specs.items()
-                              if k != "health"}
-            try:
-                state = self.checkpointer.restore(
-                    self.params, stripped,
-                    mesh=self.mesh, param_specs=self.param_specs,
-                    opt_specs=stripped_specs,
+            candidates = [(k,) for k in telemetry_subtrees]
+            if len(telemetry_subtrees) > 1:
+                candidates.append(tuple(telemetry_subtrees))
+            state = None
+            stripped_of: tuple = ()
+            for drop in candidates:
+                logger.warning(
+                    "resume: full restore failed (%s: %s); retrying without "
+                    "the telemetry %s subtree(s) in case the checkpoint "
+                    "predates them",
+                    type(orig).__name__, orig, "/".join(drop),
                 )
-            except Exception:
+                stripped = {k: v for k, v in self.opt_state.items()
+                            if k not in drop}
+                stripped_specs = {k: v for k, v in self.opt_specs.items()
+                                  if k not in drop}
+                try:
+                    state = self.checkpointer.restore(
+                        self.params, stripped,
+                        mesh=self.mesh, param_specs=self.param_specs,
+                        opt_specs=stripped_specs,
+                    )
+                    stripped_of = drop
+                    break
+                except Exception:
+                    continue
+            if state is None:
                 raise orig
-            # fresh counters, but steps_seen MUST align with the restored
-            # trainer step: last_nonfinite_step derives from it, and a
-            # misaligned value would name the wrong step (and RNG recipe)
-            # in every future anomaly bundle
-            health = dict(self.opt_state["health"])
-            health["steps_seen"] = jnp.asarray(int(state.step), jnp.int32)
-            state.opt_state = dict(state.opt_state, health=health)
+            restored_opt = dict(state.opt_state)
+            if "health" in stripped_of:
+                # fresh counters, but steps_seen MUST align with the restored
+                # trainer step: last_nonfinite_step derives from it, and a
+                # misaligned value would name the wrong step (and RNG recipe)
+                # in every future anomaly bundle
+                health = dict(self.opt_state["health"])
+                health["steps_seen"] = jnp.asarray(int(state.step), jnp.int32)
+                restored_opt["health"] = health
+            if "tensorstats" in stripped_of:
+                # the cumulative observatory record simply starts fresh — the
+                # stats are a streaming aggregate, not training state
+                restored_opt["tensorstats"] = self.opt_state["tensorstats"]
+            state.opt_state = restored_opt
             logger.info(
-                "resume: checkpoint predates telemetry.health — restored "
-                "without the health subtree, counters start fresh at step %d",
-                int(state.step),
+                "resume: checkpoint predates telemetry %s — restored without "
+                "the subtree(s), counters start fresh at step %d",
+                "/".join(stripped_of), int(state.step),
             )
         if self.fault_injector is not None:
             # drill injection point "restore": the checkpoint has been read
@@ -1231,6 +1281,9 @@ class Trainer:
             HealthMonitor,
             RecompileDetector,
             SpanTimer,
+        )
+        from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+            HIST_PREFIX as _TS_HIST_PREFIX,
         )
 
         tel = self.exp.telemetry
@@ -1657,7 +1710,17 @@ class Trainer:
                             # and the armed watchdog must escape the process
                             # (mode="hang" blocks here)
                             self.fault_injector.maybe_fire("sync", self.step)
-                        last_metrics = {k: float(v) for k, v in metrics.items()}
+                        # the tensorstats packed vectors are ARRAYS — they
+                        # ride the same boundary fetch (still the one host
+                        # sync) but must bypass the float() coercion and the
+                        # scalar sinks (-> ExpManager.log_tensorstats below)
+                        last_metrics = {}
+                        ts_payload = {}
+                        for k, v in metrics.items():
+                            if k.startswith(_TS_HIST_PREFIX):
+                                ts_payload[k] = np.asarray(v)
+                            else:
+                                last_metrics[k] = float(v)
                     if monitor is not None:
                         # anomaly policy on the ALREADY-fetched scalars: a
                         # healthy boundary costs one int compare; an anomaly
@@ -1731,6 +1794,11 @@ class Trainer:
                         # record every sink drops
                         last_metrics.update(batch_stats.drain())
                     self.exp.log_metrics(self.step, last_metrics)
+                    if ts_payload:
+                        # structured observatory record -> tensorstats.jsonl
+                        # (the per-step tensorstats/ SCALARS already rode
+                        # last_metrics into every scalar sink above)
+                        self.exp.log_tensorstats(self.step, ts_payload)
                     fleet_metrics: dict[str, float] = {}
                     if fleet is not None:
                         # this host's beacon + (rank 0) the fleet fold; a
@@ -1940,6 +2008,14 @@ class Trainer:
                 self.exp.write_run_summary(summary)
             except Exception as e:  # noqa: BLE001 — teardown must finish
                 logger.warning("goodput summary write failed: %s", e)
+        last_ts = getattr(self.exp, "last_tensorstats", None)
+        if last_ts:
+            # the final cumulative observatory record — the snapshot
+            # tools/quant_readiness.py prices compressed collectives from
+            try:
+                self.exp.write_run_summary({"tensorstats": last_ts})
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("tensorstats summary write failed: %s", e)
         itrail = self._merged_integrity_trail()
         if itrail:
             # the integrity trail (docs/elasticity.md "Integrity &

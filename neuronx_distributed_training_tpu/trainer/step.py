@@ -61,6 +61,7 @@ def make_train_step(
     health_cfg: Any = None,  # telemetry.health.HealthConfig (numerics probes)
     bucket_plan: Any = None,  # optim.overlap.BucketPlan (engineered overlap)
     prefetch_ag: bool = True,
+    tensorstats_cfg: Any = None,  # telemetry.tensorstats.TensorStatsConfig
 ) -> Callable:
     """Build the (un-jitted) train step:
     ``(params, opt_state, batch, step_key) -> (params, opt_state, metrics)``.
@@ -79,10 +80,21 @@ def make_train_step(
     health=True)`` must have created), and — under ``policy: skip_update`` —
     the in-graph suppression of a non-finite update.  All of it rides the one
     jitted executable; the host sees the results only at the boundary metric
-    fetch it already performs."""
+    fetch it already performs.
+
+    ``tensorstats_cfg`` (enabled): the tensor numerics observatory
+    (``telemetry.tensorstats``) — per layer-group dynamic-range stats of the
+    optimizer-boundary grads, cumulated in ``opt_state["tensorstats"]`` and
+    surfaced as ``tensorstats/...`` scalars plus ``tensorstats_hist/...``
+    packed vectors in the boundary metrics.  Shares the health probes' layer
+    grouping and the clipping norm's reduction pass; rides the same one
+    executable."""
     health = health_cfg if (health_cfg is not None
                             and getattr(health_cfg, "enabled", False)) else None
-    if health is not None:
+    tstats = (tensorstats_cfg
+              if tensorstats_cfg is not None
+              and getattr(tensorstats_cfg, "enabled", False) else None)
+    if health is not None or tstats is not None:
         from neuronx_distributed_training_tpu.telemetry.health import (
             grad_group_of,
         )
@@ -123,6 +135,18 @@ def make_train_step(
                 grad_sum = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(policy.grad_accum_dtype), grad_sum, grads
                 )
+                if param_specs is not None:
+                    # Pin the accumulation carry to the param sharding, not
+                    # just the post-loop grads (line ~161): the carry's
+                    # layout is otherwise re-solved from its consumers, and
+                    # extra read-only uses of the grads (the tensorstats
+                    # reductions) can tip the partitioner into a different
+                    # carry sharding that reshards the embedding-backward
+                    # scatter-add INSIDE the loop on every microbatch
+                    grad_sum = jax.tree_util.tree_map(
+                        lambda s, g: shd.constrain(g, s), param_specs,
+                        grad_sum, is_leaf=lambda x: isinstance(x, P),
+                    )
                 return (loss_sum + loss, grad_sum), aux
 
             zeros = jax.tree_util.tree_map(
@@ -154,11 +178,14 @@ def make_train_step(
         new_params, new_opt_state, opt_metrics = adamw_update(
             params, grads, opt_state, lr, opt_cfg, policy,
             trainable_mask=trainable_mask, ema_cfg=ema_cfg,
-            grad_group_fn=grad_group_of if health is not None else None,
+            grad_group_fn=(grad_group_of
+                           if (health is not None or tstats is not None)
+                           else None),
             skip_nonfinite=(health is not None
                             and health.policy == "skip_update"),
             extra_finite=(jnp.isfinite(loss) if health is not None else None),
             bucket_plan=bucket_plan, prefetch_ag=prefetch_ag,
+            tensorstats_cfg=tstats,
         )
         metrics = {
             "loss": loss,
@@ -166,6 +193,11 @@ def make_train_step(
             "grad_norm": opt_metrics["grad_norm"],
         }
         metrics.update({k: v for k, v in aux.items() if k not in metrics})
+        if tstats is not None:
+            # tensorstats/... per-step scalars + tensorstats_hist/... packed
+            # cumulative vectors — the loop's boundary fetch splits them by
+            # prefix (floats to the scalar sinks, vectors to tensorstats.jsonl)
+            metrics.update(opt_metrics.get("tensorstats", {}))
         if health is not None:
             ok = opt_metrics["updates_finite"]
             bad = jnp.logical_not(ok).astype(jnp.int32)

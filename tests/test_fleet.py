@@ -235,6 +235,24 @@ class TestAlertRules:
         with pytest.raises(ValueError, match="rel_drop"):
             parse_alerts([{"metric": "mfu", "rel_drop": 1.5}])
 
+    def test_rel_rise_parses_and_ranges(self):
+        (r,) = parse_alerts([{"metric": "data_wait", "rel_rise": 0.5}])
+        assert r.mode == "rel_rise" and r.name == "data_wait_rel_rise"
+        # unlike rel_drop there is no upper bound: 3.0 = "quadrupled"
+        (r,) = parse_alerts([{"metric": "data_wait", "rel_rise": 3.0}])
+        assert r.rel_rise == 3.0
+        with pytest.raises(ValueError, match="rel_rise"):
+            parse_alerts([{"metric": "data_wait", "rel_rise": 0.0}])
+        with pytest.raises(ValueError, match="rel_rise"):
+            parse_alerts([{"metric": "data_wait", "rel_rise": -0.2}])
+        with pytest.raises(ValueError, match="exactly ONE"):
+            parse_alerts([{"metric": "mfu", "rel_drop": 0.2,
+                           "rel_rise": 0.2}])
+
+    def test_rel_rise_did_you_mean(self):
+        with pytest.raises(ValueError, match="rel_rise"):
+            parse_alerts([{"metric": "loss", "rel_ris": 0.5}])
+
     def test_unknown_key_did_you_mean(self):
         with pytest.raises(ValueError, match="threshold"):
             parse_alerts([{"metric": "loss", "treshold": 1.0}])
@@ -487,6 +505,32 @@ class TestAlertEngine:
         assert eng.observe(4, {"mfu": 0.45}) == []
         (f2,) = eng.observe(5, {"mfu": 0.30})
         assert "0.5" in f2.message
+
+    def test_rel_rise_vs_running_minimum(self):
+        eng = self._engine({"metric": "tensorstats/pre/embed/subnormal_frac",
+                            "rel_rise": 0.5})
+        m = "tensorstats/pre/embed/subnormal_frac"
+        assert eng.observe(1, {m: 0.10}) == []  # establishes the trough
+        assert eng.observe(2, {m: 0.13}) == []  # +30%: inside band
+        (f,) = eng.observe(3, {m: 0.20})        # +100%: fires
+        assert "running minimum 0.1" in f.message
+        # the spiked value must NOT ratchet the trough up: recovery to 0.13
+        # clears, a second spike re-fires against the SAME trough
+        assert eng.observe(4, {m: 0.13}) == []
+        (f2,) = eng.observe(5, {m: 0.20})
+        assert "0.1" in f2.message
+        # a clean window BELOW the trough advances it down: 0.05 becomes the
+        # new floor, so 0.08 (+60%) now fires where it never would before
+        assert eng.observe(6, {m: 0.05}) == []
+        (f3,) = eng.observe(7, {m: 0.08})
+        assert "0.05" in f3.message
+
+    def test_rel_rise_never_fires_from_zero_trough(self):
+        # relative rise from a 0.0 trough is undefined (mirrors rel_drop's
+        # non-positive-peak guard): the rule stays silent forever
+        eng = self._engine({"metric": "x", "rel_rise": 0.5})
+        assert eng.observe(1, {"x": 0.0}) == []
+        assert eng.observe(2, {"x": 1e9}) == []
 
     def test_edge_triggered_no_refire_while_active(self):
         eng = self._engine({"metric": "loss", "threshold": 5.0})

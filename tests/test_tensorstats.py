@@ -1,0 +1,968 @@
+"""Tensor numerics observatory (telemetry/tensorstats + quant_readiness +
+the optimizer/trainer wiring): config validation, the packed cumulative
+state + its sharding specs, in-graph stat exactness (absmax/rms/zero and
+subnormal fractions/log2-exponent histogram, NaN/inf edge handling), the
+pure-observer contract (bitwise-unchanged update, bitwise no-op when off),
+a real tiny-llama train step, the fit()-level overhead contract with
+health + fleet + alerts + bucketed overlap riding alongside (AOT once, zero
+retraces, zero extra host syncs), resume from a pre-tensorstats checkpoint,
+and the block-scaled int8 quantization-readiness model with hand-computed
+SQNR pins + the tools/quant_readiness.py CLI over the committed fixture —
+all tier-1 / CPU."""
+
+import importlib.util
+import json
+import math
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_training_tpu.optim.adamw import (
+    AdamWConfig,
+    adamw_update,
+    init_opt_state,
+    opt_state_specs,
+)
+from neuronx_distributed_training_tpu.telemetry import (
+    TelemetryConfig,
+    grad_group_of,
+)
+from neuronx_distributed_training_tpu.telemetry.tensorstats import (
+    CUM_HEADER,
+    HIST_PREFIX,
+    SCALAR_PREFIX,
+    TensorStatsConfig,
+    decode_cum,
+    init_tensorstats_state,
+    split_state_key,
+    state_key,
+    tensorstats_state_specs,
+    tensorstats_update,
+)
+from neuronx_distributed_training_tpu.telemetry.quant_readiness import (
+    build_report,
+    bytes_saved_fraction,
+    load_run_dir,
+    pool_groups,
+    predict_block_quant,
+)
+from neuronx_distributed_training_tpu.utils.dtypes import DtypePolicy
+
+FIXTURE = Path(__file__).resolve().parent / "data" / "quant_readiness_fixture"
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+class TestTensorStatsConfig:
+    def test_defaults_disabled(self):
+        ts = TelemetryConfig.from_config(None).tensorstats
+        assert ts.enabled is False
+        assert ts.pre_clip is True and ts.post_clip is True
+        assert ts.buckets is False
+        assert (ts.hist_lo_exp, ts.hist_hi_exp) == (-24, 8)
+        assert ts.nbins == 33
+        assert ts.vec_len == len(CUM_HEADER) + 33
+
+    def test_bare_bool_enables(self):
+        assert TensorStatsConfig.from_config(True).enabled is True
+        assert TensorStatsConfig.from_config(False).enabled is False
+
+    def test_unknown_key_rejected_at_load(self):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+
+        cfg = {"exp_manager": {"telemetry": {"tensorstats": {"enabld": True}}},
+               "data": {"global_batch_size": 8, "micro_batch_size": 1}}
+        with pytest.raises(ValueError, match="enabld"):
+            load_config(cfg)
+
+    def test_did_you_mean(self):
+        with pytest.raises(ValueError, match="pre_clip"):
+            TensorStatsConfig.from_config({"pre_clp": True})
+
+    def test_bad_values_rejected(self):
+        with pytest.raises(ValueError, match="boolean"):
+            TensorStatsConfig.from_config({"enabled": "yes"})
+        with pytest.raises(ValueError, match="integer"):
+            TensorStatsConfig.from_config({"hist_lo_exp": "low"})
+        with pytest.raises(ValueError, match="integer"):
+            TensorStatsConfig.from_config({"hist_hi_exp": True})
+        with pytest.raises(ValueError, match="hist_hi_exp"):
+            TensorStatsConfig.from_config({"hist_lo_exp": 4, "hist_hi_exp": 4})
+        with pytest.raises(ValueError, match="256"):
+            TensorStatsConfig.from_config({"hist_lo_exp": -300,
+                                           "hist_hi_exp": 8})
+
+    def test_enabled_with_all_phases_off_rejected(self):
+        with pytest.raises(ValueError, match="nothing to record"):
+            TensorStatsConfig.from_config({"enabled": True, "pre_clip": False,
+                                           "post_clip": False,
+                                           "buckets": False})
+
+    def test_blanket_telemetry_true_keeps_tensorstats_disabled(self):
+        # like health: enabling it changes the opt-state tree (and therefore
+        # checkpoints), so a blanket bool must never opt in silently
+        assert TelemetryConfig.from_config(True).tensorstats.enabled is False
+        assert TelemetryConfig.from_config(False).tensorstats.enabled is False
+
+    def test_round_trip_through_loader(self):
+        from neuronx_distributed_training_tpu.config.loader import load_config
+
+        cfg = load_config({
+            "exp_manager": {"telemetry": {"tensorstats": {
+                "enabled": True, "post_clip": False, "buckets": True,
+                "hist_lo_exp": -16, "hist_hi_exp": 4}}},
+            "data": {"global_batch_size": 8, "micro_batch_size": 1},
+        })
+        ts = TelemetryConfig.from_config(
+            cfg["exp_manager"]["telemetry"]).tensorstats
+        assert ts.enabled and not ts.post_clip and ts.buckets
+        assert (ts.hist_lo_exp, ts.hist_hi_exp) == (-16, 4)
+        assert ts.nbins == 21
+
+
+# ---------------------------------------------------------------------------
+# state layout + sharding specs
+# ---------------------------------------------------------------------------
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "embed": {"embedding": jax.random.normal(k, (16, 8))},
+        "layers": {
+            "attn": {"qkv": {"w": jax.random.normal(k, (2, 8, 8))}},
+            "mlp": {"down": {"w": jax.random.normal(k, (2, 8, 8))}},
+            "input_norm": {"scale": jnp.ones((2, 8))},
+        },
+        "final_norm": {"scale": jnp.ones((8,))},
+    }
+
+
+_GROUPS = {"embed", "layers/attn", "layers/mlp", "layers/input_norm",
+           "final_norm"}
+
+
+def _trees_bitwise_equal(a, b) -> bool:
+    return bool(jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(x, y, equal_nan=True)), a, b)))
+
+
+class TestTensorStatsState:
+    def test_state_key_round_trip(self):
+        # checkpoint path naming must not see "/" — state keys use "."
+        assert state_key("pre", "layers/attn") == "pre.layers.attn"
+        assert split_state_key("pre.layers.attn") == ("pre", "layers/attn")
+        assert split_state_key(state_key("bucket", "g0")) == ("bucket", "g0")
+
+    def test_init_state_layout(self):
+        cfg = TensorStatsConfig(enabled=True, buckets=True)
+        state = init_tensorstats_state(cfg, _params(), bucket_groups=("b0",))
+        expect = ({"steps"}
+                  | {state_key("pre", g) for g in _GROUPS}
+                  | {state_key("post", g) for g in _GROUPS}
+                  | {state_key("bucket", "b0")})
+        assert set(state) == expect
+        assert state["steps"].dtype == jnp.int32
+        for k, v in state.items():
+            if k != "steps":
+                assert v.shape == (cfg.vec_len,) and v.dtype == jnp.float32
+
+    def test_phase_knobs_prune_slots(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        state = init_tensorstats_state(cfg, _params())
+        assert not any(k.startswith("post.") for k in state)
+        assert any(k.startswith("pre.") for k in state)
+        assert not any(k.startswith("bucket.") for k in state)
+
+    def test_opt_state_and_specs_structure_match(self, cpu_mesh):
+        from jax.sharding import PartitionSpec as P
+
+        cfg = TensorStatsConfig(enabled=True, buckets=True)
+        params = _params()
+        state = init_opt_state(params, tensorstats=cfg,
+                               tensorstats_bucket_groups=("b0",))
+        assert "tensorstats" in state
+        pspecs = jax.tree_util.tree_map(lambda _: P(), params)
+        ospecs = opt_state_specs(params, pspecs, cpu_mesh, tensorstats=cfg,
+                                 tensorstats_bucket_groups=("b0",))
+        # spec tree structure must match the state tree structure exactly
+        assert (jax.tree_util.tree_structure(state)
+                == jax.tree_util.tree_structure(
+                    jax.tree_util.tree_map(
+                        lambda x: x, ospecs,
+                        is_leaf=lambda x: isinstance(x, P))))
+        assert ospecs["tensorstats"] == tensorstats_state_specs(
+            cfg, params, bucket_groups=("b0",))
+
+    def test_disabled_adds_no_subtree(self, cpu_mesh):
+        from jax.sharding import PartitionSpec as P
+
+        params = _params()
+        assert "tensorstats" not in init_opt_state(
+            params, tensorstats=TensorStatsConfig(enabled=False))
+        pspecs = jax.tree_util.tree_map(lambda _: P(), params)
+        assert "tensorstats" not in opt_state_specs(
+            params, pspecs, cpu_mesh,
+            tensorstats=TensorStatsConfig(enabled=False))
+
+
+# ---------------------------------------------------------------------------
+# in-graph stat exactness
+# ---------------------------------------------------------------------------
+
+
+class TestStatExactness:
+    def _update(self, cfg, grads, state=None, **kw):
+        if state is None:
+            state = init_tensorstats_state(cfg, groups=["g"])
+        return tensorstats_update(state, cfg, group_fn=lambda p: "g",
+                                  grads_pre=grads, **kw)
+
+    def test_hand_computed_stats(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        grads = {"a": jnp.full((8,), 0.125, jnp.float32),
+                 "b": jnp.zeros((4,), jnp.float32)}
+        state, m = self._update(cfg, grads)
+        base = f"{SCALAR_PREFIX}pre/g"
+        assert float(m[f"{base}/absmax"]) == 0.125
+        # rms over ALL 12 elements: sqrt(8 * 0.125^2 / 12)
+        assert float(m[f"{base}/rms"]) == pytest.approx(
+            math.sqrt(8 * 0.125 ** 2 / 12), rel=1e-6)
+        assert float(m[f"{base}/zero_frac"]) == pytest.approx(4 / 12)
+        assert float(m[f"{base}/subnormal_frac"]) == 0.0
+        rec = decode_cum(np.asarray(state[state_key("pre", "g")]), cfg)
+        assert rec["count"] == 12 and rec["zero"] == 4
+        # floor(log2 0.125) = -3 -> bin -3 - (-24) = 21 holds the 8 values
+        assert rec["hist"][-3 - cfg.hist_lo_exp] == 8
+        assert sum(rec["hist"]) == 8
+
+    def test_subnormal_and_inf_edges(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        # 1e-40 is f32-subnormal (tiny ~1.18e-38).  Backends with
+        # flush-to-zero arithmetic (XLA CPU among them) see it as an exact
+        # zero, so the two small values land in EITHER the zero or the
+        # subnormal fraction — never dropped, never double-counted.
+        # +/-inf always lands in the top histogram bin.
+        grads = {"a": jnp.asarray([0.0, 1e-40, -1e-40, jnp.inf],
+                                  jnp.float32)}
+        state, m = self._update(cfg, grads)
+        base = f"{SCALAR_PREFIX}pre/g"
+        zf = float(m[f"{base}/zero_frac"])
+        sf = float(m[f"{base}/subnormal_frac"])
+        assert zf + sf == pytest.approx(3 / 4)
+        assert zf >= 1 / 4  # the true zero is a zero everywhere
+        assert math.isinf(float(m[f"{base}/absmax"]))
+        rec = decode_cum(np.asarray(state[state_key("pre", "g")]), cfg)
+        assert rec["hist"][-1] == 1         # inf in the top bin
+        # subnormals (when not flushed) clip into the bottom bin
+        assert rec["hist"][0] == rec["subnormal"]
+        assert sum(rec["hist"]) == 1 + rec["subnormal"]
+        # the non-finite sumsq/absmax step contribution was dropped by the
+        # cumulative merge (a poisoned step must not poison the whole run)
+        assert math.isfinite(rec["absmax"]) and math.isfinite(rec["sumsq"])
+
+    def test_subnormal_slot_decodes(self):
+        # the decode side of the subnormal fraction, independent of backend
+        # flush-to-zero behavior: hand-pack a cumulative vector
+        cfg = TensorStatsConfig(enabled=True)
+        vec = [0.0] * cfg.vec_len
+        vec[0], vec[1], vec[2], vec[3], vec[4] = 8.0, 1.0, 0.5, 2.0, 3.0
+        rec = decode_cum(vec, cfg)
+        assert rec["zero_frac"] == pytest.approx(2 / 8)
+        assert rec["subnormal_frac"] == pytest.approx(3 / 8)
+        assert rec["rms"] == pytest.approx(math.sqrt(1.0 / 8))
+
+    def test_nan_excluded_from_hist_and_sanitized_in_cum(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        grads = {"a": jnp.asarray([jnp.nan, 0.5], jnp.float32)}
+        state, m = self._update(cfg, grads)
+        # per-step scalars stay honest: the NaN poisons absmax/rms
+        assert math.isnan(float(m[f"{SCALAR_PREFIX}pre/g/absmax"]))
+        rec = decode_cum(np.asarray(state[state_key("pre", "g")]), cfg)
+        assert sum(rec["hist"]) == 1        # only the 0.5 binned
+        assert rec["absmax"] == 0.5 or rec["absmax"] == 0.0
+        assert math.isfinite(rec["sumsq"])
+
+    def test_cumulative_over_steps(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        g1 = {"a": jnp.full((8,), 0.125, jnp.float32)}
+        g2 = {"a": jnp.full((8,), 0.5, jnp.float32)}
+        state, _ = self._update(cfg, g1)
+        state, m = self._update(cfg, g2, state=state)
+        assert int(state["steps"]) == 2
+        rec = decode_cum(np.asarray(state[state_key("pre", "g")]), cfg)
+        assert rec["count"] == 16
+        assert rec["absmax"] == 0.5         # running max across steps
+        assert rec["sumsq"] == pytest.approx(8 * 0.125 ** 2 + 8 * 0.5 ** 2)
+        assert rec["hist"][-3 - cfg.hist_lo_exp] == 8
+        assert rec["hist"][-1 - cfg.hist_lo_exp] == 8
+        # the HIST_PREFIX metric IS the cumulative vector
+        assert np.array_equal(np.asarray(m[f"{HIST_PREFIX}pre/g"]),
+                              np.asarray(state[state_key("pre", "g")]))
+
+    def test_group_sq_override_shares_clip_reduction(self):
+        # the pre-clip rms must reuse the clipping norm's squared sums, not
+        # recompute them: an override value shows up verbatim in the rms
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        grads = {"a": jnp.full((12,), 0.125, jnp.float32)}
+        _, m = self._update(cfg, grads,
+                            group_sq={"g": jnp.asarray(999.0, jnp.float32)})
+        assert float(m[f"{SCALAR_PREFIX}pre/g/rms"]) == pytest.approx(
+            math.sqrt(999.0 / 12), rel=1e-6)
+
+    def test_unknown_group_slot_raises(self):
+        cfg = TensorStatsConfig(enabled=True, post_clip=False)
+        state = init_tensorstats_state(cfg, groups=["g"])
+        with pytest.raises(KeyError, match="disagree"):
+            tensorstats_update(state, cfg, group_fn=lambda p: "h",
+                               grads_pre={"a": jnp.ones((2,))})
+
+
+# ---------------------------------------------------------------------------
+# adamw integration: the pure-observer contract
+# ---------------------------------------------------------------------------
+
+
+class TestAdamWTensorStats:
+    def test_update_bitwise_unchanged_by_observer(self):
+        params = _params()
+        grads = jax.tree_util.tree_map(lambda p: 0.1 * p, params)
+        cfg = TensorStatsConfig(enabled=True)
+        o1 = init_opt_state(params)
+        o2 = init_opt_state(params, tensorstats=cfg)
+        # both runs use the grouped-norm path (tensorstats forces it on), so
+        # the update math is instruction-for-instruction the same
+        p1, s1, _ = adamw_update(params, grads, o1, 1e-3, AdamWConfig(),
+                                 grad_group_fn=grad_group_of)
+        p2, s2, _ = adamw_update(params, grads, o2, 1e-3, AdamWConfig(),
+                                 tensorstats_cfg=cfg)
+        assert _trees_bitwise_equal(p1, p2)
+        assert _trees_bitwise_equal(
+            s1, {k: v for k, v in s2.items() if k != "tensorstats"})
+
+    def test_metrics_emitted_per_phase_and_group(self):
+        params = _params()
+        grads = jax.tree_util.tree_map(lambda p: 0.1 * p, params)
+        cfg = TensorStatsConfig(enabled=True)
+        opt = init_opt_state(params, tensorstats=cfg)
+        _, s, m = adamw_update(params, grads, opt, 1e-3, AdamWConfig(),
+                               tensorstats_cfg=cfg)
+        ts = m["tensorstats"]
+        for phase in ("pre", "post"):
+            for g in _GROUPS:
+                for stat in ("absmax", "rms", "zero_frac", "subnormal_frac"):
+                    assert f"{SCALAR_PREFIX}{phase}/{g}/{stat}" in ts
+                hv = ts[f"{HIST_PREFIX}{phase}/{g}"]
+                assert hv.shape == (cfg.vec_len,)
+        assert int(s["tensorstats"]["steps"]) == 1
+
+    def test_post_clip_sees_clipped_grads(self):
+        params = _params()
+        # huge grads so the clip actually bites
+        grads = jax.tree_util.tree_map(lambda p: 100.0 * p, params)
+        cfg = TensorStatsConfig(enabled=True)
+        opt = init_opt_state(params, tensorstats=cfg)
+        acfg = AdamWConfig(grad_clip_norm=1.0)
+        _, _, m = adamw_update(params, grads, opt, 1e-3, acfg,
+                               tensorstats_cfg=cfg)
+        ts = m["tensorstats"]
+        pre = float(ts[f"{SCALAR_PREFIX}pre/embed/absmax"])
+        post = float(ts[f"{SCALAR_PREFIX}post/embed/absmax"])
+        assert post < pre  # the clip shrank the observed magnitudes
+
+    def test_skipped_step_reverts_observer_state_too(self):
+        # skip_nonfinite must keep the WHOLE donated opt state bitwise equal
+        # — including the tensorstats record (the skipped step contributed
+        # nothing; the per-step scalars still showed the event)
+        params = _params()
+        grads = jax.tree_util.tree_map(lambda p: 0.1 * p, params)
+        grads["embed"]["embedding"] = (
+            grads["embed"]["embedding"].at[0, 0].set(jnp.nan))
+        cfg = TensorStatsConfig(enabled=True)
+        opt = init_opt_state(params, tensorstats=cfg)
+        _, s, m = adamw_update(params, grads, opt, 1e-3, AdamWConfig(),
+                               skip_nonfinite=True, tensorstats_cfg=cfg)
+        assert not bool(m["updates_finite"])
+        assert _trees_bitwise_equal(s, opt)
+
+    def test_disabled_cfg_is_inert(self):
+        params = _params()
+        grads = jax.tree_util.tree_map(lambda p: 0.1 * p, params)
+        opt = init_opt_state(params)
+        _, s, m = adamw_update(params, grads, opt, 1e-3, AdamWConfig(),
+                               tensorstats_cfg=TensorStatsConfig(
+                                   enabled=False))
+        assert "tensorstats" not in m and "tensorstats" not in s
+
+
+# ---------------------------------------------------------------------------
+# make_train_step: the observatory on a real tiny llama step
+# ---------------------------------------------------------------------------
+
+
+def _llama_step(ts_cfg):
+    from neuronx_distributed_training_tpu.models import llama
+    from neuronx_distributed_training_tpu.optim.lr import constant_lr
+    from neuronx_distributed_training_tpu.telemetry import HealthConfig
+    from neuronx_distributed_training_tpu.trainer.step import make_train_step
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_attention_heads=4, num_kv_heads=2, max_position_embeddings=16)
+    policy = DtypePolicy()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, policy)
+    hc = HealthConfig(enabled=True, policy="skip_update")
+    opt = init_opt_state(params, policy, health=True, tensorstats=ts_cfg)
+
+    def loss_fn(p, batch, key):
+        return llama.forward(p, batch, cfg, policy)
+
+    step = jax.jit(make_train_step(
+        loss_fn, AdamWConfig(), constant_lr(1e-3), policy, health_cfg=hc,
+        tensorstats_cfg=ts_cfg))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64,
+                             dtype=jnp.int32)
+    batch = {"input_ids": ids, "labels": ids,
+             "loss_mask": jnp.ones((4, 16), jnp.float32)}
+    return step, params, opt, batch
+
+
+class TestTrainStepTensorStats:
+    def test_stats_ride_the_one_jitted_step(self):
+        ts_cfg = TensorStatsConfig(enabled=True)
+        step, params, opt, batch = _llama_step(ts_cfg)
+        _, o1, m = step(params, opt, batch, jax.random.PRNGKey(2))
+        assert float(m["health/updates_finite"]) == 1.0
+        # metric keys keep the "/" group spelling (state keys use ".")
+        assert f"{SCALAR_PREFIX}pre/layers/attn/absmax" in m
+        assert f"{SCALAR_PREFIX}post/embed/rms" in m
+        hist = {k for k in m if k.startswith(HIST_PREFIX)}
+        assert f"{HIST_PREFIX}pre/embed" in hist
+        assert np.asarray(m[f"{HIST_PREFIX}pre/embed"]).shape == (
+            ts_cfg.vec_len,)
+        assert int(o1["tensorstats"]["steps"]) == 1
+        # rms consistency with the health grad-norm plane: same reduction
+        g = "layers/attn"
+        rec = decode_cum(np.asarray(m[f"{HIST_PREFIX}pre/{g}"]), ts_cfg)
+        np.testing.assert_allclose(
+            math.sqrt(rec["sumsq"]), float(m[f"health/grad_norm/{g}"]),
+            rtol=1e-5)
+
+    def test_disabled_adds_no_keys(self):
+        step, params, opt, batch = _llama_step(
+            TensorStatsConfig(enabled=False))
+        _, o, m = step(params, opt, batch, jax.random.PRNGKey(2))
+        assert not any(k.startswith(SCALAR_PREFIX) for k in m)
+        assert not any(k.startswith(HIST_PREFIX) for k in m)
+        assert "tensorstats" not in o
+
+
+# ---------------------------------------------------------------------------
+# fit()-level contract: observatory + health + fleet + alerts + bucketed
+# overlap, all riding ONE compiled step with zero extra host syncs
+# ---------------------------------------------------------------------------
+
+
+def _ts_cfg(tmp_path, *, max_steps=6, log_every=1):
+    from neuronx_distributed_training_tpu.config.loader import load_config
+
+    return load_config({
+        "name": "tstats", "model_source": "hf", "seed": 7,
+        "trainer": {"max_steps": max_steps, "log_every_n_steps": log_every},
+        "exp_manager": {"exp_dir": str(tmp_path / "exp"),
+                        "create_tensorboard_logger": False,
+                        "log_files": False,
+                        "telemetry": {
+                            "health": {"enabled": True,
+                                       "policy": "skip_update",
+                                       "ring_buffer_steps": 8},
+                            "tensorstats": {"enabled": True,
+                                            "buckets": True},
+                            "fleet": {"enabled": True,
+                                      "stale_after_seconds": 600},
+                            "alerts": [{"metric":
+                                        "tensorstats/pre/embed/rms",
+                                        "rel_rise": 1000.0,
+                                        "action": "log"}],
+                        }},
+        "distributed_strategy": {"tensor_model_parallel_size": 2,
+                                 "sequence_parallel": True, "zero1": True,
+                                 "overlap": {"zero1_bucket_mb": 0.0625,
+                                             "prefetch_ag": True}},
+        "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                 "seq_length": 32, "synthetic": True},
+        "model": {"vocab_size": 128, "hidden_size": 64,
+                  "intermediate_size": 128, "num_layers": 2,
+                  "num_attention_heads": 4, "num_key_value_heads": 2,
+                  "max_position_embeddings": 32,
+                  "optim": {"name": "adamw_fp32OptState", "lr": 1e-3}},
+        "precision": {"type": "mixed_precision"},
+    })
+
+
+def _data_module():
+    from neuronx_distributed_training_tpu.data import SyntheticDataModule
+
+    return SyntheticDataModule(vocab_size=128, seq_len=32,
+                               global_batch_size=8, seed=3)
+
+
+class TestFitContract:
+    @pytest.fixture(scope="class")
+    def observatory_run(self, tmp_path_factory, devices8):
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        tmp_path = tmp_path_factory.mktemp("tstats")
+        cfg = _ts_cfg(tmp_path)
+        t = Trainer.from_config(cfg, data_module=_data_module(),
+                                enable_checkpointing=False)
+        metrics = t.fit()
+        return t, metrics, Path(t.exp.log_dir)
+
+    def test_aot_once_zero_retraces(self, observatory_run):
+        t, _, log_dir = observatory_run
+        assert not hasattr(t.train_step, "lower")
+        summary = json.loads((log_dir / "run_summary.json").read_text())
+        assert "retrace_events" not in summary
+        assert "anomalies" not in summary
+
+    def test_scalars_in_metrics_jsonl_hist_routed_around(self,
+                                                         observatory_run):
+        _, _, log_dir = observatory_run
+        records = [json.loads(l) for l in
+                   (log_dir / "metrics.jsonl").read_text().splitlines()]
+        last = records[-1]
+        assert any(k.startswith(f"{SCALAR_PREFIX}pre/") for k in last)
+        assert any(k.startswith(f"{SCALAR_PREFIX}post/") for k in last)
+        assert any(k.startswith(f"{SCALAR_PREFIX}bucket/") for k in last)
+        # the packed vectors must NEVER reach the scalar stream
+        assert not any(k.startswith(HIST_PREFIX) for r in records for k in r)
+        # health rides alongside, unchanged
+        assert last["health/updates_finite"] == 1.0
+
+    def test_tensorstats_jsonl_cumulates(self, observatory_run):
+        _, _, log_dir = observatory_run
+        lines = (log_dir / "tensorstats.jsonl").read_text().splitlines()
+        records = [json.loads(l) for l in lines]
+        for l in lines:  # strict JSON: no bare NaN/Infinity tokens
+            json.dumps(json.loads(l), allow_nan=False)
+        assert [r["step"] for r in records] == [1, 2, 3, 4, 5, 6]
+        first, last = records[0], records[-1]
+        assert "pre/embed" in last["groups"]
+        assert any(k.startswith("bucket/") for k in last["groups"])
+        # the cumulative count grows linearly with steps
+        assert last["groups"]["pre/embed"]["count"] == pytest.approx(
+            6 * first["groups"]["pre/embed"]["count"])
+        # absmax is a running max: monotone non-decreasing across records
+        trail = [r["groups"]["pre/embed"]["absmax"] for r in records]
+        assert trail == sorted(trail)
+
+    def test_run_summary_section(self, observatory_run):
+        _, _, log_dir = observatory_run
+        summary = json.loads((log_dir / "run_summary.json").read_text())
+        ts = summary["tensorstats"]
+        assert ts["step"] == 6
+        assert ts["hist_lo_exp"] == -24 and ts["hist_hi_exp"] == 8
+        assert set(ts["groups"]) >= {"pre/embed", "post/embed"}
+        # ...and it is exactly what quant readiness consumes
+        inputs = load_run_dir(log_dir)
+        report = build_report(inputs["tensorstats"])
+        assert report["classes"]["reduce-scatter"]["pooled"]
+
+    def test_beacons_carry_tensorstats(self, observatory_run):
+        _, _, log_dir = observatory_run
+        beacon = next((log_dir / "fleet").glob("host_*.jsonl"))
+        records = [json.loads(l) for l in
+                   beacon.read_text().splitlines()]
+        # the final line is the metrics-less closing record; the boundary
+        # beacons before it must carry the per-step scalars (and never the
+        # packed vectors)
+        boundary = [r for r in records if not r.get("closing")]
+        assert boundary
+        assert all(any(k.startswith(SCALAR_PREFIX) for k in r["metrics"])
+                   for r in boundary)
+        assert not any(k.startswith(HIST_PREFIX)
+                       for r in records for k in r["metrics"])
+
+    def test_quant_readiness_runs_on_fresh_artifacts(self, observatory_run,
+                                                     capsys):
+        _, _, log_dir = observatory_run
+        qr = _load_tool("quant_readiness")
+        assert qr.main([str(log_dir), "--json", "-"]) == 0
+        out = capsys.readouterr().out
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["ok"] is True
+        assert "reduce-scatter" in payload["classes"]
+
+
+class TestDispatchAheadContractWithTensorstats:
+    def test_no_host_sync_between_boundaries(self, tmp_path, devices8):
+        """The observatory must add ZERO host syncs between boundaries: the
+        per-step scalars are converted to host floats only at boundary steps
+        and the packed vectors bypass float() entirely."""
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ts_cfg(tmp_path, max_steps=6, log_every=3)
+        t = Trainer.from_config(cfg, data_module=_data_module(),
+                                enable_checkpointing=False)
+
+        conversions: list[int] = []
+
+        class _Scalar:
+            def __init__(self, step, value=1.0):
+                self.step, self.value = step, value
+
+            def __float__(self):
+                conversions.append(self.step)
+                return self.value
+
+        real_params, real_opt = t.params, t.opt_state
+        vec_len = TensorStatsConfig(enabled=True).vec_len
+
+        def fake_step(params, opt_state, batch, key):
+            return real_params, real_opt, {
+                "loss": _Scalar(t.step),
+                "grad_norm": _Scalar(t.step),
+                "health/updates_finite": _Scalar(t.step),
+                "health/nonfinite_count": _Scalar(t.step, 0.0),
+                "health/last_nonfinite_step": _Scalar(t.step, -1.0),
+                f"{SCALAR_PREFIX}pre/embed/absmax": _Scalar(t.step, 0.5),
+                f"{SCALAR_PREFIX}pre/embed/rms": _Scalar(t.step, 0.1),
+                f"{HIST_PREFIX}pre/embed": np.zeros(vec_len, np.float32),
+            }
+
+        t.train_step = fake_step
+        t.fit()
+        assert conversions, "boundaries must fetch metrics"
+        # pre-increment step ids 2 and 5 -> boundaries at steps 3 and 6; the
+        # ring-buffered steps 0,1,3,4 must never have been fetched
+        assert set(conversions) == {2, 5}, sorted(set(conversions))
+
+
+class TestResumeCompat:
+    def test_resume_from_pre_tensorstats_checkpoint(self, tmp_path, devices8):
+        """Flipping tensorstats on must not strand an existing run: a
+        checkpoint written WITHOUT the subtree restores with a fresh
+        cumulative record — and KEEPS the health subtree it does carry
+        (the strip-retry is narrowest-first)."""
+        from neuronx_distributed_training_tpu.checkpoint import TrainState
+        from neuronx_distributed_training_tpu.trainer.loop import Trainer
+
+        cfg = _ts_cfg(tmp_path)
+        t = Trainer.from_config(cfg, data_module=_data_module(),
+                                enable_checkpointing=False)
+        assert "tensorstats" in t.opt_state and "health" in t.opt_state
+
+        class LegacyCheckpointer:
+            """Restores a pre-tensorstats checkpoint: raises on a template
+            that carries the tensorstats subtree (the orbax structure
+            mismatch), but accepts health — like a real store from the
+            previous release would."""
+
+            config = type("C", (), {"every_n_train_steps": 0})
+
+            def latest_step(self):
+                return 4
+
+            def restore(self, params, opt_state, **kw):
+                if "tensorstats" in opt_state:
+                    raise ValueError("tree structure mismatch: 'tensorstats'")
+                return TrainState(params=params, opt_state=opt_state,
+                                  step=4, consumed_samples=32)
+
+            def wait(self):
+                pass
+
+            def close(self):
+                pass
+
+        t.checkpointer = LegacyCheckpointer()
+        assert t.maybe_resume() is True
+        assert t.step == 4
+        # fresh observatory record re-attached; health survived the retry
+        assert "tensorstats" in t.opt_state and "health" in t.opt_state
+        assert int(t.opt_state["tensorstats"]["steps"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# quantization-readiness model: hand-computed pins
+# ---------------------------------------------------------------------------
+
+
+def _single_bin(count=4096, exp=-3, lo=-24, nbins=33):
+    hist = [0] * nbins
+    hist[exp - lo] = count
+    return hist
+
+
+class TestQuantModel:
+    def test_bytes_saved_fraction(self):
+        # int8 payload + one fp32 scale per block, vs fp32 wire
+        assert bytes_saved_fraction(32) == pytest.approx(0.71875)
+        assert bytes_saved_fraction(128) == pytest.approx(0.7421875)
+        assert bytes_saved_fraction(512) == pytest.approx(0.748046875)
+        # vs a bf16 wire the win halves (scale amortized the same way)
+        assert bytes_saved_fraction(128, 2.0) == pytest.approx(
+            1.0 - 1.03125 / 2.0)
+        with pytest.raises(ValueError, match="block_size"):
+            bytes_saved_fraction(0)
+
+    def test_uniform_single_bin_sqnr_exact(self):
+        # every element 2^-3: block absmax exponent is -3 with certainty at
+        # ANY block size, scale = 2^-2/127, SQNR = 12*127^2/4 ~= 46.847 dB
+        expect = round(10 * math.log10(12 * 127 ** 2 / 4.0), 3)
+        for b in (1, 32, 128, 512):
+            p = predict_block_quant(_single_bin(), -24, count=4096.0,
+                                    sumsq=64.0, block_size=b)
+            assert p["sqnr_db"] == expect == 46.847
+            assert p["rel_error_rms"] == pytest.approx(
+                math.sqrt(4.0 / (12 * 127 ** 2)), rel=1e-6)
+
+    def test_zero_mass_blocks(self):
+        # half the elements exact zeros, half 2^-3.  B=1: all-zero "blocks"
+        # contribute no noise AND no signal — SQNR is unchanged vs no zeros
+        hist = _single_bin(count=2048)
+        p1 = predict_block_quant(hist, -24, count=4096.0, sumsq=32.0,
+                                 zero_count=2048.0, block_size=1)
+        assert p1["sqnr_db"] == 46.847
+        # B=2: only 1/4 of blocks are all-zero; noise weight 3/4 on the -3
+        # scale against the same halved signal -> 12*127^2/6
+        p2 = predict_block_quant(hist, -24, count=4096.0, sumsq=32.0,
+                                 zero_count=2048.0, block_size=2)
+        assert p2["sqnr_db"] == round(10 * math.log10(12 * 127 ** 2 / 6.0), 3)
+
+    def test_spread_distribution_degrades_with_block_size(self):
+        # two exponent bins 8 apart: larger blocks are dominated by the big
+        # exponent's scale while half the mass is small -> SQNR decreases
+        nbins = 33
+        hist = [0] * nbins
+        hist[-3 - (-24)] = 2048
+        hist[-11 - (-24)] = 2048
+        sumsq = 2048 * 2.0 ** -6 + 2048 * 2.0 ** -22
+        sq = [predict_block_quant(hist, -24, count=4096.0, sumsq=sumsq,
+                                  block_size=b)["sqnr_db"]
+              for b in (1, 32, 512)]
+        assert sq[0] > sq[1] >= sq[2]
+
+    def test_degenerate_distributions(self):
+        p = predict_block_quant([0] * 33, -24, count=0.0, sumsq=0.0)
+        assert p["sqnr_db"] is None and p["rel_error_rms"] is None
+        p = predict_block_quant([0] * 33, -24, count=64.0, sumsq=0.0,
+                                zero_count=64.0)
+        assert p["sqnr_db"] is None  # all zeros: nothing to quantize
+        assert p["bytes_saved_frac"] == pytest.approx(0.7421875)
+
+    def test_pool_groups(self):
+        a = {"count": 4, "sumsq": 1.0, "zero": 1, "absmax": 0.5,
+             "hist_lo_exp": -24, "hist_hi_exp": 8, "hist": _single_bin(3)}
+        b = {"count": 2, "sumsq": 2.0, "zero": 0, "absmax": 2.0,
+             "hist_lo_exp": -24, "hist_hi_exp": 8,
+             "hist": _single_bin(2, exp=1)}
+        pooled = pool_groups({"a": a, "b": b})
+        assert pooled["count"] == 6 and pooled["sumsq"] == 3.0
+        assert pooled["absmax"] == 2.0 and pooled["zero"] == 1
+        assert pooled["hist"][-3 - (-24)] == 3
+        assert pooled["hist"][1 - (-24)] == 2
+        with pytest.raises(ValueError, match="pool"):
+            pool_groups({"a": a, "b": dict(b, hist_lo_exp=-16)})
+
+    def test_build_report_ranking_and_savings(self):
+        ts = {"step": 3, "groups": {
+            "pre/embed": {"count": 4096, "sumsq": 64.0, "zero": 0,
+                          "absmax": 0.125, "hist_lo_exp": -24,
+                          "hist_hi_exp": 8, "hist": _single_bin()}}}
+        overlap = {"reduce-scatter": {"exposed_seconds": 0.2},
+                   "all-reduce": {"wire_seconds": 0.05,
+                                  "hidden_seconds": 0.04}}
+        vols = {"tp": {"reduce-scatter": 1000.0, "all-gather": 1000.0},
+                "pp": {"collective-permute": 500.0}}
+        r = build_report(ts, byte_volumes=vols, overlap_by_class=overlap)
+        rs = r["classes"]["reduce-scatter"]
+        # savings priced at the LARGEST block size over measured exposure
+        assert rs["block_size"] == 512
+        assert rs["predicted_seconds_saved"] == pytest.approx(
+            0.2 * 0.748046875)
+        assert rs["bytes_saved_per_step"] == pytest.approx(
+            1000.0 * 0.748046875)
+        assert rs["pooled"]["512"]["sqnr_db"] == 46.847
+        # exposed falls back to wire - hidden when unmeasured
+        ar = r["classes"]["all-reduce"]
+        assert ar["exposed_seconds"] == pytest.approx(0.01)
+        # activation traffic: bytes only, error side marked unavailable
+        cp = r["classes"]["collective-permute"]
+        assert cp["phase"] is None and "activation" in cp["note"]
+        assert r["ranking"][0] == "reduce-scatter"
+        # the all-gather class had no bucket capture: note, not a crash
+        assert "note" in r["classes"]["all-gather"]
+
+    def test_build_report_without_telemetry(self):
+        r = build_report(None, byte_volumes={"dp": {"all-reduce": 10.0}})
+        assert r["step"] is None
+        assert r["classes"]["all-reduce"]["bytes_saved_per_step"] > 0
+
+
+# ---------------------------------------------------------------------------
+# planner byte volumes (autotune.cost_model.collective_byte_volumes)
+# ---------------------------------------------------------------------------
+
+
+class TestByteVolumes:
+    def test_matches_hand_math(self, tmp_path):
+        from neuronx_distributed_training_tpu.autotune.cost_model import (
+            collective_byte_volumes,
+        )
+        from neuronx_distributed_training_tpu.autotune.space import ModelFacts
+
+        cfg = _ts_cfg(tmp_path)
+        facts = ModelFacts.from_config(cfg)
+        plan = facts.declared_plan_for(8)
+        assert plan is not None and plan.tp == 2 and plan.dp == 4
+        vols = collective_byte_volumes(facts, plan)
+        # tp under SP: one AG/RS pair per 4 activations x hidden x bf16 x
+        # fwd+bwd per layer; tokens_chip = 8*32/4 = 64
+        layer_total = 4.0 * 64 * 64 * 2.0 * 2.0 * 2
+        assert vols["tp"]["all-gather"] == pytest.approx(layer_total / 2)
+        assert vols["tp"]["reduce-scatter"] == pytest.approx(layer_total / 2)
+        # vocab-parallel CE: two [tokens] f32 all-reduces per microbatch
+        assert vols["tp"]["all-reduce"] == pytest.approx(2.0 * 2.0 * 64 * 4.0)
+        # ZeRO-1 dp splits into grad reduce-scatter + param all-gather
+        assert set(vols["dp"]) == {"reduce-scatter", "all-gather"}
+        assert all(v > 0 for v in vols["dp"].values())
+        # the report accepts the axis-nested shape directly
+        r = build_report(None, byte_volumes=vols)
+        assert r["classes"]["reduce-scatter"]["bytes_per_step"] == (
+            pytest.approx(layer_total / 2 + vols["dp"]["reduce-scatter"]))
+
+
+# ---------------------------------------------------------------------------
+# committed fixture + tools/quant_readiness.py CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    path = Path(__file__).resolve().parents[1] / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestQuantReadinessFixture:
+    def test_fixture_internally_consistent(self):
+        # the committed tensorstats.jsonl's LAST record must equal the
+        # run_summary section — load_run_dir prefers the latter, the CLI
+        # must behave the same whichever survives
+        summary = json.loads((FIXTURE / "run_summary.json").read_text())
+        last = json.loads(
+            (FIXTURE / "tensorstats.jsonl").read_text().splitlines()[-1])
+        assert last == summary["tensorstats"]
+
+    def test_load_and_report(self):
+        inputs = load_run_dir(FIXTURE)
+        assert inputs["tensorstats"]["step"] == 6
+        r = build_report(inputs["tensorstats"],
+                         overlap_by_class=inputs["overlap_by_class"])
+        # exposure 0.1 / 0.04 / 0.01 s -> savings rank in that order
+        assert r["ranking"][:3] == ["reduce-scatter", "all-gather",
+                                    "all-reduce"]
+        rs = r["classes"]["reduce-scatter"]
+        assert rs["predicted_seconds_saved"] == pytest.approx(
+            0.1 * 0.748046875)
+        # the all-2^-3 attn group pins the hand-computed SQNR exactly
+        attn = rs["per_group"]["layers.attn"]
+        assert attn["512"]["sqnr_db"] == 46.847
+        # the underflow-heavy final_norm ranks worst of the pre groups
+        per = {g: p["512"]["sqnr_db"] for g, p in rs["per_group"].items()}
+        assert min(per, key=per.get) == "final_norm"
+        # bucket phase feeds the all-gather class
+        ag = r["classes"]["all-gather"]
+        assert ag["pooled"]["512"]["sqnr_db"] == 46.847
+
+    def test_missing_run_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="tensorstats"):
+            load_run_dir(tmp_path)
+
+    def test_cli_smoke_json_last_line(self, capsys):
+        qr = _load_tool("quant_readiness")
+        assert qr.main([str(FIXTURE), "--json", "-"]) == 0
+        out = capsys.readouterr().out
+        assert "reduce-scatter" in out  # human-readable section
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["ok"] is True
+        assert payload["ranking"][0] == "reduce-scatter"
+        assert payload["classes"]["reduce-scatter"]["pooled"]
+
+    def test_cli_error_path(self, tmp_path, capsys):
+        qr = _load_tool("quant_readiness")
+        assert qr.main([str(tmp_path), "--json", "-"]) == 2
+        out = capsys.readouterr().out
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["ok"] is False and "tensorstats" in payload["error"]
+
+    def test_cli_with_config_byte_volumes(self, tmp_path, capsys):
+        import yaml
+
+        cfg_path = tmp_path / "cfg.yaml"
+        cfg_path.write_text(yaml.safe_dump({
+            "name": "t", "model_source": "hf",
+            "trainer": {"max_steps": 2, "devices": 8},
+            "distributed_strategy": {"tensor_model_parallel_size": 2,
+                                     "sequence_parallel": True,
+                                     "zero1": True},
+            "data": {"global_batch_size": 8, "micro_batch_size": 1,
+                     "seq_length": 32, "synthetic": True},
+            "model": {"vocab_size": 128, "hidden_size": 64,
+                      "intermediate_size": 128, "num_layers": 2,
+                      "num_attention_heads": 4, "num_key_value_heads": 2,
+                      "max_position_embeddings": 32},
+            "precision": {"type": "mixed_precision"},
+        }))
+        qr = _load_tool("quant_readiness")
+        assert qr.main([str(FIXTURE), "--config", str(cfg_path),
+                        "--json", "-"]) == 0
+        out = capsys.readouterr().out
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["ok"] is True
+        rs = payload["classes"]["reduce-scatter"]
+        assert rs["bytes_per_step"] and rs["bytes_saved_per_step"] > 0
+
+
+# ---------------------------------------------------------------------------
+# tools/anomaly_report.py: the dynamic-range trail section
+# ---------------------------------------------------------------------------
+
+
+class TestAnomalyReportTensorstats:
+    def test_trail_rendered_from_ring(self, tmp_path, capsys):
+        from neuronx_distributed_training_tpu.telemetry import (
+            HealthConfig,
+            HealthMonitor,
+        )
+
+        mon = HealthMonitor(
+            HealthConfig(enabled=True, ring_buffer_steps=8),
+            dump_dir=tmp_path)
+        for s in range(3):
+            mon.record(s, {
+                "loss": 1.0,
+                "health/nonfinite_count": 0.0 if s < 2 else 1.0,
+                f"{SCALAR_PREFIX}pre/embed/absmax": 0.5 + s,
+                f"{SCALAR_PREFIX}pre/embed/rms": 0.1,
+                f"{SCALAR_PREFIX}pre/embed/zero_frac": 0.0,
+                f"{SCALAR_PREFIX}pre/embed/subnormal_frac": 0.25,
+            })
+        mon.check_boundary(3, {"health/nonfinite_count": 1.0,
+                               "health/last_nonfinite_step": 2.0})
+        ar = _load_tool("anomaly_report")
+        assert ar.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "tensorstats absmax trail" in out
+        assert "tensorstats dynamic range" in out
+        assert "subnormal_frac" in out and "embed" in out

@@ -133,6 +133,43 @@ def group_norms_section(ring: list[dict], anomaly_step: int) -> str:
             + _table(rows, ("group", "grad_norm")))
 
 
+def tensorstats_section(ring: list[dict]) -> str:
+    """Per-layer-group dynamic-range trail (``telemetry.tensorstats``) —
+    the "which group's gradients underflowed / blew up on the way in"
+    companion to the param-norm drift column above."""
+    prefix = "tensorstats/pre/"
+    groups = sorted({k[len(prefix):].rsplit("/", 1)[0]
+                     for e in ring for k in (e.get("metrics") or {})
+                     if k.startswith(prefix)})
+    if not groups:
+        return ""
+    shown = groups[:6]  # keep the table terminal-width sane
+    rows = []
+    for e in ring:
+        m = e.get("metrics") or {}
+        rows.append((str(e.get("step")),
+                     *(_fmt(m[f"{prefix}{g}/absmax"])
+                       if f"{prefix}{g}/absmax" in m else "-"
+                       for g in shown)))
+    out = ("\ntensorstats absmax trail (pre-clip grads, oldest first)\n"
+           + _table(rows, ("step", *shown)))
+    if len(groups) > len(shown):
+        out += f"\n  (+{len(groups) - len(shown)} more groups not shown)"
+    last = ring[-1].get("metrics") or {}
+    urows = [(g, _fmt(last.get(f"{prefix}{g}/rms", "-")),
+              _fmt(last.get(f"{prefix}{g}/zero_frac", "-")),
+              _fmt(last.get(f"{prefix}{g}/subnormal_frac", "-")))
+             for g in groups
+             if any(f"{prefix}{g}/{s}" in last
+                    for s in ("rms", "zero_frac", "subnormal_frac"))]
+    if urows:
+        out += (f"\n\ntensorstats dynamic range (step "
+                f"{ring[-1].get('step')})\n"
+                + _table(urows, ("group", "rms", "zero_frac",
+                                 "subnormal_frac")))
+    return out
+
+
 def fingerprint_section(ring: list[dict], anomaly_step: int) -> str:
     entry = next((e for e in ring if e.get("step") == anomaly_step), None)
     fp = (entry or {}).get("fingerprint")
@@ -153,7 +190,8 @@ def render(bundle_dir: str) -> str:
             ring = json.load(f)
     step = int(summary.get("anomaly_step", -1))
     parts = [summary_section(summary), ring_section(ring),
-             group_norms_section(ring, step), fingerprint_section(ring, step)]
+             group_norms_section(ring, step), tensorstats_section(ring),
+             fingerprint_section(ring, step)]
     stacks = os.path.join(bundle_dir, "stacks.txt")
     if os.path.exists(stacks):
         parts.append(f"\npython stacks: {stacks}")
