@@ -1295,9 +1295,16 @@ def main() -> None:
                          "rows in the JSON line — PC203 gates bucketed "
                          "exposed <= monolithic (runs INSTEAD of the "
                          "headline single-chip bench)")
+    ap.add_argument("--comms", action="store_true",
+                    help="run the interconnect sweep (telemetry.comms) "
+                         "AFTER the timed loop on a small tp=2/pp=2 mesh "
+                         "and embed per-axis fitted bandwidth + per-class "
+                         "achieved_gbps in the headline JSON line "
+                         "(verdict-gated via PC204; tools/comms_bench.py "
+                         "is the standalone, full-control version)")
     args = ap.parse_args()
 
-    if (args.schedule_sweep or args.overlap_sweep) \
+    if (args.schedule_sweep or args.overlap_sweep or args.comms) \
             and args.platform == "cpu":
         # the sweeps need a multi-device mesh; opportunistically request 8
         # virtual CPU devices — effective only when jax has not been
@@ -1622,6 +1629,48 @@ def main() -> None:
         payload["regime_errors"] = errors
     if backend_err:
         payload["backend_retries"] = backend_err
+    if args.comms:
+        # interconnect sweep AFTER the timed loop (telemetry.comms): time
+        # the collective classes on a small tp=2/pp=2 mesh, fit per-axis
+        # bandwidth/latency, and embed the facts block — PC204 then rides
+        # the same verdict the headline carries
+        try:
+            import jax as _jax
+
+            from neuronx_distributed_training_tpu.autotune.topology import (
+                resolve_topology,
+            )
+            from neuronx_distributed_training_tpu.parallel.mesh import (
+                MeshConfig,
+                build_mesh,
+            )
+            from neuronx_distributed_training_tpu.telemetry import (
+                comms as _comms,
+            )
+
+            devs = _jax.devices()
+            tp = 2 if len(devs) % 2 == 0 and len(devs) >= 2 else 1
+            pp = 2 if len(devs) % (tp * 2) == 0 and len(devs) >= 4 else 1
+            mesh = build_mesh(MeshConfig(tensor_model_parallel_size=tp,
+                                         pipeline_model_parallel_size=pp),
+                              devs)
+            sizes = (1 << 18, 1 << 20) if not on_tpu else (1 << 22, 1 << 24)
+            axis_results = _comms.run_comms_sweep(
+                mesh, sizes_bytes=sizes, warmup=1, reps=3)
+            topo = resolve_topology(device=devs[0])
+            summary = _comms.build_comms_summary(
+                axis_results, topology_name=topo.name,
+                prior_bandwidth_bytes=topo.ici_bandwidth_bytes,
+                prior_latency_seconds=topo.ici_latency_seconds,
+                device_skew=_comms.measure_device_skew(devs))
+            payload["comms"] = _comms.bench_comms_facts(summary)
+            payload["comms_findings"] = summary.get("findings") or []
+            log(f"bench: comms sweep fitted axes="
+                f"{sorted((payload['comms'].get('axes') or {}))}")
+        except Exception as e:  # noqa: BLE001 — the headline must survive
+            payload["comms"] = None
+            payload["comms_error"] = f"{type(e).__name__}: {e}"[:300]
+            log(f"bench: comms sweep failed: {payload['comms_error']}")
     if args.calibration:
         # low-fidelity connect-reliability line — must be distinguishable
         # from headline measurements by any later reader of the jsonl

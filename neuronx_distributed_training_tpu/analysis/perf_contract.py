@@ -22,7 +22,9 @@ silently eroding the recorded baselines.
   overlap, PC202 exposed collective seconds naming the collective class,
   PC203 engineered-overlap ordering — multi-bucket + prefetch ZeRO-1
   variants must expose at most the monolithic regather's collective
-  seconds within one ``--overlap-sweep`` run, PC301 measured bubble growth, PC302
+  seconds within one ``--overlap-sweep`` run, PC204 per-class/per-axis
+  achieved interconnect bandwidth dropping beyond its band
+  (``telemetry.comms``), PC301 measured bubble growth, PC302
   measured-vs-predicted bubble outside the calibration band, PC401
   cost-model residual drift, PC501 measured peak-HBM growth, PC502
   measured peak HBM beyond the planner's predicted total x the calibration
@@ -90,6 +92,12 @@ DEFAULT_NOISE: dict[str, float] = {
                                   # come from trace intervals, which jitter
                                   # harder under host scheduling than whole
                                   # step times do.
+    "comms_bw_frac": 0.50,        # per-class/per-axis achieved interconnect
+                                  # bandwidth drop beyond this fraction fails
+                                  # PC204 (telemetry.comms) — wide by
+                                  # default: wire timings jitter harder than
+                                  # step times, and committed CPU baselines
+                                  # widen it further in-file
 }
 
 #: which subsystem a measured collective class's regression points at —
@@ -166,6 +174,49 @@ def _overlap_classes(mapping: Any) -> dict[str, dict[str, Any]]:
     return out
 
 
+def _comms_facts(block: Any) -> Optional[dict[str, Any]]:
+    """Normalize a comms block (telemetry.comms) into canonical facts.
+
+    Accepts either shape the observatory emits: a bench/comms_bench facts
+    block ({"classes": ..., "axes": ...}) or the trainer's trace/run summary
+    ``comms`` section ({"classes": {kind: {achieved_gbps, efficiency, ...}}}).
+    Returns {"classes", "axes"} with only the numeric fields PC204 diffs,
+    or None when the block carries nothing usable."""
+    if not isinstance(block, Mapping):
+        return None
+    classes: dict[str, dict[str, float]] = {}
+    for kind, entry in dict(block.get("classes") or {}).items():
+        if not isinstance(entry, Mapping):
+            continue
+        rec = {}
+        for field in ("achieved_gbps", "efficiency"):
+            v = _num(entry.get(field))
+            if v is not None:
+                rec[field] = v
+        if rec:
+            classes[str(kind)] = rec
+    axes: dict[str, dict[str, float]] = {}
+    for axis, entry in dict(block.get("axes") or {}).items():
+        if not isinstance(entry, Mapping):
+            continue
+        rec = {}
+        for field in ("bandwidth_gbps", "latency_us", "bandwidth_ratio"):
+            v = _num(entry.get(field))
+            if v is not None:
+                rec[field] = v
+        if rec:
+            axes[str(axis)] = rec
+    if not classes and not axes:
+        return None
+    out: dict[str, Any] = {"classes": classes}
+    if axes:
+        out["axes"] = axes
+    peak = _num(block.get("peak_bandwidth_gbps"))
+    if peak is not None:
+        out["peak_bandwidth_gbps"] = peak
+    return out
+
+
 def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
     """Canonical facts out of one ``bench.py`` headline JSON line."""
     mfu = _num(payload.get("mfu"))
@@ -205,6 +256,7 @@ def perf_facts_from_bench(payload: Mapping[str, Any]) -> dict[str, Any]:
         if isinstance(payload.get("residuals"), Mapping) else None,
         "schedule_sweep": _sweep_rows(payload.get("schedule_sweep")),
         "overlap_sweep": _overlap_rows(payload.get("overlap_sweep")),
+        "comms": _comms_facts(payload.get("comms")),
     }
 
 
@@ -277,6 +329,7 @@ def perf_facts_from_trace_summary(summary: Mapping[str, Any]
         "hbm_headroom_fraction": None,
         "predicted_hbm_bytes": None,
         "residuals": None,
+        "comms": _comms_facts(summary.get("comms")),
     }
 
 
@@ -371,6 +424,10 @@ def perf_facts_from_run(run_dir: str | Path) -> dict[str, Any]:
         predicted = _num((mem.get("predicted") or {}).get("total"))
     facts["peak_hbm_bytes"] = peak
     facts["predicted_hbm_bytes"] = predicted
+    if facts.get("comms") is None:
+        # the trainer writes the comms section into run_summary.json even
+        # when no trace window fired (the in-loop join needs only metrics)
+        facts["comms"] = _comms_facts(run_summary.get("comms"))
     return facts
 
 
@@ -450,6 +507,9 @@ def default_key(facts: Mapping[str, Any]) -> str:
     if src == "bench" and w.get("metric") == "zero1_overlap_sweep":
         # likewise the engineered-overlap sweep (bench.py --overlap-sweep)
         return f"{slug}_overlap_sweep"
+    if src == "bench" and w.get("metric") == "comms_bench_sweep":
+        # and the interconnect sweep (tools/comms_bench.py)
+        return f"{slug}_comms"
     return f"{slug}_{src}" if src != "bench" else f"{slug}_bench"
 
 
@@ -863,6 +923,69 @@ def diff_facts(old: Mapping[str, Any], new: Mapping[str, Any], *,
                     f"--update-baselines",
                 )
 
+    # -- PC204: per-class / per-axis achieved interconnect bandwidth -------
+    # telemetry.comms joins wire times with the cost model's byte volumes
+    # (in-loop) or times the collectives directly (tools/comms_bench.py);
+    # either way achieved_gbps dropping beyond the band means the wire got
+    # slower for the SAME traffic — a degraded link, a lost overlap slot,
+    # or a topology misconfiguration, not a workload change.
+    ocomms = old.get("comms") if isinstance(old.get("comms"), Mapping) else {}
+    ncomms = new.get("comms") if isinstance(new.get("comms"), Mapping) else {}
+    band = bands["comms_bw_frac"]
+    oclasses = dict(ocomms.get("classes") or {})
+    nclasses = dict(ncomms.get("classes") or {})
+    for kind in sorted(oclasses):
+        axes, subsystem = CLASS_HINTS.get(
+            kind, ("?", "unattributed collective class"))
+        a = _num((oclasses.get(kind) or {}).get("achieved_gbps"))
+        b = _num((nclasses.get(kind) or {}).get("achieved_gbps"))
+        if not a or b is None:
+            continue
+        if b < a * (1.0 - band):
+            report.add(
+                "PC204", "error",
+                f"[{axes}]-axis achieved {kind} bandwidth dropped "
+                f"{_fmt(a, 3)} -> {_fmt(b, 3)} GB/s "
+                f"(-{100 * (1 - b / a):.0f}% > {100 * band:.0f}% band): "
+                f"the interconnect got slower for {subsystem}",
+                location=kind,
+                hint="tools/comms_bench.py isolates the wire from the "
+                     "workload (per-axis fit + per-device skew names a "
+                     "degraded link); " + _RATCHET_HINT,
+            )
+        elif b > a * (1.0 + band):
+            report.add(
+                "PC110", "info",
+                f"[{axes}]-axis achieved {kind} bandwidth improved "
+                f"{_fmt(a, 3)} -> {_fmt(b, 3)} GB/s — tighten with "
+                f"--update-baselines",
+            )
+    oaxes = dict(ocomms.get("axes") or {})
+    naxes = dict(ncomms.get("axes") or {})
+    for axis in sorted(oaxes):
+        a = _num((oaxes.get(axis) or {}).get("bandwidth_gbps"))
+        b = _num((naxes.get(axis) or {}).get("bandwidth_gbps"))
+        if not a or b is None:
+            continue
+        if b < a * (1.0 - band):
+            report.add(
+                "PC204", "error",
+                f"fitted {axis}-axis bandwidth dropped {_fmt(a, 3)} -> "
+                f"{_fmt(b, 3)} GB/s (-{100 * (1 - b / a):.0f}% > "
+                f"{100 * band:.0f}% band): the sweep's linear fit says this "
+                f"mesh axis's wire decalibrated",
+                location=axis,
+                hint="comms_summary.json's device_skew findings name a "
+                     "degraded device when one host is the cause; "
+                     + _RATCHET_HINT,
+            )
+        elif b > a * (1.0 + band):
+            report.add(
+                "PC110", "info",
+                f"fitted {axis}-axis bandwidth improved {_fmt(a, 3)} -> "
+                f"{_fmt(b, 3)} GB/s — tighten with --update-baselines",
+            )
+
     # overall exposed wire time (catches a class that vanished from the
     # per-class table by being renamed)
     a = _num(old.get("exposed_collective_seconds"))
@@ -988,6 +1111,24 @@ def residual_report(estimate: Mapping[str, Any],
         "measured_exposed_seconds": m_exposed,
         "ratio": round(m_exposed / pred_comms, 4)
         if pred_comms and m_exposed is not None else None,
+    }
+    # achieved interconnect bandwidth (telemetry.comms): how fast the wire
+    # actually moved the bytes the cost model priced — None rows when the
+    # run carried no comms section (the join needs the byte-volume facts)
+    mcomms = (measured.get("comms")
+              if isinstance(measured.get("comms"), Mapping) else {}) or {}
+    mclasses = dict(mcomms.get("classes") or {})
+    ach = {k: _num(v.get("achieved_gbps"))
+           for k, v in mclasses.items() if isinstance(v, Mapping)}
+    ach = {k: v for k, v in ach.items() if v is not None}
+    effs = [_num(v.get("efficiency")) for v in mclasses.values()
+            if isinstance(v, Mapping)]
+    effs = [e for e in effs if e is not None]
+    out["comms_bandwidth"] = {
+        "peak_gbps": _num(mcomms.get("peak_bandwidth_gbps")),
+        "achieved_gbps_by_class":
+            {k: round(v, 6) for k, v in sorted(ach.items())} or None,
+        "mean_efficiency": round(sum(effs) / len(effs), 6) if effs else None,
     }
     pred_bubble_s = _num(estimate.get("bubble_seconds"))
     pred_bubble_frac = (round(pred_bubble_s / pred_total, 6)

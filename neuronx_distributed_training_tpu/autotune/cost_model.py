@@ -462,6 +462,91 @@ def overlap_from_trace_summary(summary: Any) -> dict[str, float]:
     return out
 
 
+#: sanity clamp on measured/prior interconnect bandwidth ratios — a
+#: degenerate sweep (one noisy rep, a collapsed fit) must not price an axis
+#: as free or as 50x the wire
+_COMMS_RATIO_BOUNDS = (0.02, 50.0)
+
+
+def _clamp_comms_ratio(v: Any) -> float:
+    lo, hi = _COMMS_RATIO_BOUNDS
+    return min(max(float(v), lo), hi)
+
+
+def comms_calibration_from_summary(summary: Any) -> dict[str, float]:
+    """Measured/prior per-axis bandwidth ratios out of a
+    ``comms_summary.json`` payload (the dict, its file path, or a run dir
+    containing it) — the interconnect analogue of
+    :func:`overlap_from_trace_summary` / :func:`hbm_calibration_from_memory_summary`.
+
+    The summary records the topology prior it was benched against
+    (``prior.ici_bandwidth_bytes``) alongside each axis's fitted bandwidth
+    (``telemetry.comms.build_comms_summary``), so the extraction is
+    self-contained: ratio = fitted / prior, clamped to
+    :data:`_COMMS_RATIO_BOUNDS`.  Only axes with a usable fit produce a
+    ratio — calibration never pretends.  Raises ``ValueError`` when the
+    summary carries no usable axis (the planner turns that into a report
+    error)."""
+    from neuronx_distributed_training_tpu.telemetry.comms import (
+        load_comms_summary,
+    )
+
+    summary = load_comms_summary(summary)
+    prior = (summary.get("prior") or {}).get("ici_bandwidth_bytes")
+    try:
+        prior = float(prior or 0.0)
+    except (TypeError, ValueError):
+        prior = 0.0
+    axes = summary.get("axes") or {}
+    if not isinstance(axes, Mapping):
+        raise ValueError(
+            "malformed comms summary: 'axes' must be a mapping of per-axis "
+            f"sweep results, got {type(axes).__name__}"
+        )
+    out: dict[str, float] = {}
+    for axis, entry in axes.items():
+        if not isinstance(entry, Mapping):
+            raise ValueError(
+                f"malformed comms summary: axes[{axis!r}] must be a mapping "
+                f"with a 'fit' block, got {type(entry).__name__}"
+            )
+        ratio = entry.get("bandwidth_ratio")
+        if ratio is None and prior > 0:
+            fit = entry.get("fit") or {}
+            bw = fit.get("bandwidth_bytes_per_s") \
+                if isinstance(fit, Mapping) else None
+            if bw:
+                ratio = float(bw) / prior
+        if ratio is not None:
+            out[str(axis)] = _clamp_comms_ratio(ratio)
+    if not out:
+        raise ValueError(
+            "comms summary carries no fitted per-axis bandwidth (empty "
+            "sweep, or no prior recorded) — nothing to calibrate the "
+            "interconnect model from"
+        )
+    return out
+
+
+def _comms_topos(topo: ChipTopology,
+                 calibration: Optional[Mapping[str, float]]
+                 ) -> dict[str, ChipTopology]:
+    """Per-axis topologies with MEASURED bandwidth substituted for the
+    table prior (``ici_bandwidth_bytes x clamped ratio``); axes without a
+    measurement keep the prior.  Latency stays the table's — the fitted
+    intercepts are too rep-noisy to price against (docs/autotuning.md)."""
+    if not calibration:
+        return {}
+    out: dict[str, ChipTopology] = {}
+    for axis, ratio in calibration.items():
+        out[str(axis)] = dataclasses.replace(
+            topo,
+            ici_bandwidth_bytes=topo.ici_bandwidth_bytes
+            * _clamp_comms_ratio(ratio),
+        )
+    return out
+
+
 # --------------------------------------------------------------------------
 # time model
 # --------------------------------------------------------------------------
@@ -515,7 +600,8 @@ class PlanEstimate:
 def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
                   *, hbm_headroom: float = 0.9,
                   overlap: Any = None,
-                  hbm_calibration: Optional[Mapping[str, float]] = None
+                  hbm_calibration: Optional[Mapping[str, float]] = None,
+                  comms_calibration: Optional[Mapping[str, float]] = None
                   ) -> PlanEstimate:
     """Score one plan.  ``fits`` is False when the HBM estimate exceeds
     ``hbm_headroom`` x the topology's capacity (the runtime and fragmentation
@@ -524,7 +610,11 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
     each axis's collective wire time is priced as hidden under compute.
     ``hbm_calibration`` — measured/prior ratios per HBM category
     (:func:`hbm_calibration_from_memory_summary`) — reprices the memory
-    model with what a ``telemetry.memory`` capture actually observed."""
+    model with what a ``telemetry.memory`` capture actually observed.
+    ``comms_calibration`` — measured/prior per-axis bandwidth ratios
+    (:func:`comms_calibration_from_summary`) — reprices each comms axis at
+    the bandwidth a ``tools/comms_bench.py`` sweep actually measured on the
+    wire instead of the topology table's peak."""
     from neuronx_distributed_training_tpu.utils.perf import (
         flops_breakdown_for_model,
     )
@@ -555,17 +645,21 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
     tokens_chip = facts.global_batch_size * facts.seq / (plan.dp * plan.cp)
     h = facts.hidden
     comms: dict[str, float] = {}
+    # measured-bandwidth substitution: each axis prices against its own
+    # (possibly comms_bench-calibrated) topology view
+    ctopo = _comms_topos(topo, comms_calibration)
+    axis_topo = lambda axis: ctopo.get(axis, topo)
 
     # tp: per layer, fwd+bwd move ~4 gathered-activation volumes each way
     # (SP's AG/RS pairs; plain TP's all-reduces cost the same wire bytes)
     if plan.tp > 1:
         per_layer_bytes = 4.0 * tokens_chip * h * abytes
         comms["tp"] = 2.0 * facts.num_layers / plan.pp * _ring_seconds(
-            per_layer_bytes, plan.tp, topo)
+            per_layer_bytes, plan.tp, axis_topo("tp"))
         # vocab-parallel CE: two tiny [tokens] all-reduces per microbatch
         comms["tp"] += plan.num_microbatches * _ring_seconds(
-            2.0 * tokens_chip / plan.num_microbatches * 4, plan.tp, topo,
-            allreduce=True)
+            2.0 * tokens_chip / plan.num_microbatches * 4, plan.tp,
+            axis_topo("tp"), allreduce=True)
 
     # dp: ZeRO-1 reduce-scatter(grads f32) + all-gather(params); plain dp
     # all-reduces grads.  Engineered overlap (distributed_strategy.overlap.
@@ -582,20 +676,22 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
         grad_bytes = params_per_device(facts, plan) \
             * _dtype_bytes(policy.reduce_dtype)
         if facts.zero1:
-            comms["dp"] = _ring_seconds(grad_bytes, plan.dp, topo) \
+            comms["dp"] = _ring_seconds(grad_bytes, plan.dp,
+                                        axis_topo("dp")) \
                 + _ring_seconds(
                     params_per_device(facts, plan)
-                    * _dtype_bytes(policy.param_dtype), plan.dp, topo,
-                    hops=n_buckets * (plan.dp - 1))
+                    * _dtype_bytes(policy.param_dtype), plan.dp,
+                    axis_topo("dp"), hops=n_buckets * (plan.dp - 1))
         else:
-            comms["dp"] = _ring_seconds(grad_bytes, plan.dp, topo,
-                                        allreduce=True)
+            comms["dp"] = _ring_seconds(grad_bytes, plan.dp,
+                                        axis_topo("dp"), allreduce=True)
 
     # pp: 2*nm point-to-point hidden hops per chip (fwd + bwd)
     if plan.pp > 1:
         hop = plan.micro_batch_size * (facts.seq / plan.cp) * h * abytes
+        pp_topo = axis_topo("pp")
         comms["pp"] = 2.0 * plan.num_microbatches * (
-            hop / topo.ici_bandwidth_bytes + topo.ici_latency_seconds)
+            hop / pp_topo.ici_bandwidth_bytes + pp_topo.ici_latency_seconds)
 
     # cp: ring kv passes (ring/zigzag) or qkvo all-to-alls (ulysses),
     # fwd + 2x bwd
@@ -605,17 +701,17 @@ def estimate_plan(facts: ModelFacts, plan: Plan, topo: ChipTopology,
         if facts.cp_fusion == "ulysses":
             a2a = 2.0 * tokens_chip * h * abytes
             comms["cp"] = 3.0 * facts.num_layers / plan.pp * _ring_seconds(
-                a2a, plan.cp, topo)
+                a2a, plan.cp, axis_topo("cp"))
         else:
             comms["cp"] = 3.0 * facts.num_layers / plan.pp * _ring_seconds(
-                kv_bytes, plan.cp, topo)
+                kv_bytes, plan.cp, axis_topo("cp"))
 
     # ep: token dispatch + combine all-to-alls, fwd + 2x bwd
     if plan.ep > 1 and facts.num_experts:
         n_moe = facts.num_layers // max(facts.moe_frequency, 1)
         route_bytes = tokens_chip * max(facts.top_k, 1) * h * abytes
         comms["ep"] = 3.0 * n_moe / plan.pp * _ring_seconds(
-            route_bytes, plan.ep, topo)
+            route_bytes, plan.ep, axis_topo("ep"))
 
     # XLA overlaps collectives with compute aggressively (async collective
     # fusion; per-layer SP gathers hide under the matmuls that consume

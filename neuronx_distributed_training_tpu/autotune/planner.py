@@ -34,6 +34,7 @@ from neuronx_distributed_training_tpu.autotune.cost_model import (
     PlanEstimate,
     estimate_hbm_bytes,
     estimate_plan,
+    comms_calibration_from_summary,
     hbm_calibration_from_memory_summary,
     overlap_from_trace_summary,
     priced_hbm_calibration,
@@ -115,6 +116,10 @@ class PlanReport:
     #: memory_summary.json``); ``total`` is the headline predicted-vs-
     #: actual audit ratio — reported, not applied per-category
     hbm_calibration: Optional[dict] = None
+    #: measured/prior per-axis interconnect bandwidth ratios the ranking
+    #: priced with (a ``tools/comms_bench.py`` sweep via ``--calibrate-from
+    #: comms_summary.json`` — ``cost_model.comms_calibration_from_summary``)
+    comms_calibration: Optional[dict] = None
 
     @property
     def winner(self) -> Optional[PlanCandidate]:
@@ -140,6 +145,10 @@ class PlanReport:
         if self.hbm_calibration is not None:
             d["hbm_calibration"] = {k: round(float(v), 4)
                                     for k, v in self.hbm_calibration.items()}
+        if self.comms_calibration is not None:
+            d["comms_calibration"] = {
+                k: round(float(v), 4)
+                for k, v in self.comms_calibration.items()}
         w = self.winner
         d["winner"] = dataclasses.asdict(w.plan) if w else None
         if self.error:
@@ -189,6 +198,12 @@ class PlanReport:
                 for k, v in sorted(self.hbm_calibration.items()))
             lines.append(
                 f"HBM calibration (measured/prior): {ratios}")
+        if self.comms_calibration:
+            ratios = ", ".join(
+                f"{k}={float(v):.2f}"
+                for k, v in sorted(self.comms_calibration.items()))
+            lines.append(
+                f"comms bandwidth (measured/prior): {ratios}")
         cf = self.calibration_facts or {}
         if cf:
             bits = []
@@ -244,6 +259,7 @@ def rank_plans(
     max_mbs: int = 8,
     overlap: Any = None,
     hbm_calibration: Optional[Mapping[str, float]] = None,
+    comms_calibration: Optional[Mapping[str, float]] = None,
 ) -> tuple[list[PlanCandidate], int, int]:
     """Enumerate + score the lattice.  Returns (ranked candidates, lattice
     size, fitting count).  Plans over the HBM budget rank strictly below
@@ -252,11 +268,14 @@ def rank_plans(
     :func:`~.cost_model.estimate_plan` — a measured calibration reprices
     every plan's comms term and can reorder the ranking; ``hbm_calibration``
     (measured/prior ratios from a ``telemetry.memory`` capture) reprices
-    the memory model the same way."""
+    the memory model the same way; ``comms_calibration`` (measured/prior
+    per-axis bandwidth from a ``tools/comms_bench.py`` sweep) reprices each
+    comms axis at the bandwidth the wire actually delivered."""
     plans = enumerate_plans(facts, chips, max_mbs=max_mbs)
     scored = [(p, estimate_plan(facts, p, topo, hbm_headroom=hbm_headroom,
                                 overlap=overlap,
-                                hbm_calibration=hbm_calibration))
+                                hbm_calibration=hbm_calibration,
+                                comms_calibration=comms_calibration))
               for p in plans]
     n_fit = sum(1 for _, e in scored if e.fits)
     scored.sort(key=lambda pe: (not pe[1].fits, pe[1].step_seconds)
@@ -368,12 +387,14 @@ def plan_config(
     report is analytic-only (the ``--check`` gate's fast path).
 
     ``calibration`` — a ``trace_summary.json`` (``telemetry.trace``), a
-    ``memory_summary.json`` (``telemetry.memory``), a run dir holding
-    either/both, or a loaded dict of either — replaces the topology
-    table's comms-overlap prior with the MEASURED per-collective-class
-    overlap and/or the HBM model's transient constants with MEASURED
-    per-category ratios, so predicted cost reflects what this workload
-    actually did (``tools/plan.py --calibrate-from``)."""
+    ``memory_summary.json`` (``telemetry.memory``), a ``comms_summary.json``
+    (``tools/comms_bench.py``), a run dir holding any of them, or a loaded
+    dict of any — replaces the topology table's comms-overlap prior with
+    the MEASURED per-collective-class overlap, the HBM model's transient
+    constants with MEASURED per-category ratios, and/or the per-axis
+    interconnect bandwidth with MEASURED wire rates, so predicted cost
+    reflects what this workload actually did
+    (``tools/plan.py --calibrate-from``)."""
     from neuronx_distributed_training_tpu.config.loader import load_config
 
     name = (Path(source).name if isinstance(source, (str, Path))
@@ -398,9 +419,11 @@ def plan_config(
     measured = False
     calibration_facts: Optional[dict] = None
     hbm_cal: Optional[dict] = None
+    comms_cal: Optional[dict] = None
     if calibration is not None:
         try:
-            trace_doc, memory_doc = _resolve_calibration(calibration)
+            trace_doc, memory_doc, comms_doc = _resolve_calibration(
+                calibration)
         except (OSError, ValueError) as e:
             return PlanReport(config=name, chips=chips, topology=topo.name,
                               candidates=[], n_plans=0, n_fit=0, facts=facts,
@@ -442,12 +465,22 @@ def plan_config(
                     candidates=[], n_plans=0, n_fit=0, facts=facts,
                     error=f"HBM calibration failed: "
                           f"{type(e).__name__}: {e}")
-        if trace_doc is None and memory_doc is None:
+        if comms_doc is not None:
+            try:
+                comms_cal = comms_calibration_from_summary(comms_doc)
+            except (OSError, ValueError) as e:
+                return PlanReport(
+                    config=name, chips=chips, topology=topo.name,
+                    candidates=[], n_plans=0, n_fit=0, facts=facts,
+                    error=f"comms calibration failed: "
+                          f"{type(e).__name__}: {e}")
+        if trace_doc is None and memory_doc is None and comms_doc is None:
             return PlanReport(
                 config=name, chips=chips, topology=topo.name,
                 candidates=[], n_plans=0, n_fit=0, facts=facts,
-                error="calibration source carries neither a trace summary "
-                      "nor a memory summary — nothing to calibrate from")
+                error="calibration source carries neither a trace summary, "
+                      "a memory summary, nor a comms summary — nothing to "
+                      "calibrate from")
     overlap_used = dict(resolve_overlap(overlap, topo), measured=measured)
     # the report shows the RAW measured ratios; pricing uses the
     # conservative subset — "total" is the audit headline (not a
@@ -457,13 +490,15 @@ def plan_config(
     priced_cal = (priced_hbm_calibration(hbm_cal) if hbm_cal else None)
     ranked, n_plans, n_fit = rank_plans(
         facts, chips, topo, hbm_headroom=hbm_headroom, max_mbs=max_mbs,
-        overlap=overlap, hbm_calibration=priced_cal or None)
+        overlap=overlap, hbm_calibration=priced_cal or None,
+        comms_calibration=comms_cal or None)
     if not ranked:
         return PlanReport(config=name, chips=chips, topology=topo.name,
                           candidates=[], n_plans=0, n_fit=0, facts=facts,
                           overlap=overlap_used,
                           calibration_facts=calibration_facts,
                           hbm_calibration=hbm_cal,
+                          comms_calibration=comms_cal,
                           error="no legal plan for this chip count "
                                 "(check divisibility of heads/layers/batch)")
     if audit:
@@ -476,7 +511,8 @@ def plan_config(
                         candidates=candidates, n_plans=n_plans, n_fit=n_fit,
                         facts=facts, overlap=overlap_used,
                         calibration_facts=calibration_facts,
-                        hbm_calibration=hbm_cal)
+                        hbm_calibration=hbm_cal,
+                        comms_calibration=comms_cal)
     w = report.winner
     if calibration_facts is not None and w is not None \
             and calibration_facts.get("bubble_fraction_measured") is not None \
@@ -493,34 +529,50 @@ def plan_config(
 
 
 def _resolve_calibration(source: Any) -> tuple[Optional[dict],
+                                               Optional[dict],
                                                Optional[dict]]:
-    """``--calibrate-from`` source -> ``(trace_doc, memory_doc)`` — either
-    may be None.  A run dir yields both when both summaries exist; a file
-    or loaded dict is classified by content (``telemetry.memory.
-    is_memory_summary``)."""
+    """``--calibrate-from`` source -> ``(trace_doc, memory_doc,
+    comms_doc)`` — any may be None.  A run dir yields every summary that
+    exists in it; a file or loaded dict is classified by content
+    (``telemetry.comms.is_comms_summary`` first — its kind marker is
+    explicit — then ``telemetry.memory.is_memory_summary``, else a trace
+    summary)."""
     import json
 
+    from neuronx_distributed_training_tpu.telemetry.comms import (
+        is_comms_summary,
+    )
     from neuronx_distributed_training_tpu.telemetry.memory import (
         is_memory_summary,
     )
 
+    def _classify(doc: dict) -> tuple[Optional[dict], Optional[dict],
+                                      Optional[dict]]:
+        if is_comms_summary(doc):
+            return None, None, doc
+        if is_memory_summary(doc):
+            return None, doc, None
+        return doc, None, None
+
     if isinstance(source, Mapping):
-        doc = dict(source)
-        return (None, doc) if is_memory_summary(doc) else (doc, None)
+        return _classify(dict(source))
     p = Path(source)
     if p.is_dir():
-        trace_doc = memory_doc = None
+        trace_doc = memory_doc = comms_doc = None
         tp = p / "trace_summary.json"
         mp = p / "memory_summary.json"
+        cp = p / "comms_summary.json"
         if tp.exists():
             trace_doc = json.loads(tp.read_text())
         if mp.exists():
             memory_doc = json.loads(mp.read_text())
-        return trace_doc, memory_doc
+        if cp.exists():
+            comms_doc = json.loads(cp.read_text())
+        return trace_doc, memory_doc, comms_doc
     doc = json.loads(p.read_text())
     if not isinstance(doc, dict):
         raise ValueError(f"{p}: not a summary document")
-    return (None, doc) if is_memory_summary(doc) else (doc, None)
+    return _classify(doc)
 
 
 def _first_device():

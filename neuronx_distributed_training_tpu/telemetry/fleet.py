@@ -197,7 +197,8 @@ class FleetBeacon:
         for k, v in (metrics or {}).items():
             if k in BEACON_METRICS or k.startswith("health/") \
                     or k.startswith("data/") or k.startswith("memory/") \
-                    or k.startswith("tensorstats/"):
+                    or k.startswith("tensorstats/") \
+                    or k.startswith("comms/"):
                 try:
                     f = float(v)
                 except (TypeError, ValueError):
@@ -302,6 +303,10 @@ class _HostState:
         self.last_exception: Optional[str] = None
         # ordered step -> record of recent NON-final beacons
         self.recent: dict[int, dict] = {}
+        # sticky comms/* metrics: the achieved-bandwidth join fires once
+        # per trace window, not per beacon — the next regular beacon would
+        # otherwise erase it from `last` before anyone reads the spread
+        self.comms: dict[str, float] = {}
 
     def fold(self, rec: dict) -> None:
         self.beacons += 1
@@ -318,6 +323,12 @@ class _HostState:
                 self.last = rec
             return
         self.last = rec
+        for k, v in dict(rec.get("metrics") or {}).items():
+            if k.startswith("comms/") and v is not None:
+                try:
+                    self.comms[k] = float(v)
+                except (TypeError, ValueError):
+                    pass
         try:
             step = int(rec["step"])
         except (KeyError, TypeError, ValueError):
@@ -533,6 +544,13 @@ class FleetAggregator:
             ):
                 if getter is not None:
                     per_metric[key][h.host] = float(getter)
+            # achieved interconnect bandwidth (telemetry.comms beacons):
+            # per-host spread on comms/*/achieved_gbps is how ONE host's
+            # degraded link shows up fleet-wide — the spread table renders
+            # whatever keys land here, no per-metric plumbing needed
+            for k, v in h.comms.items():
+                if k.endswith("/achieved_gbps"):
+                    per_metric.setdefault(k, {})[h.host] = float(v)
 
         quiet = self.quiet_hosts(now=now)
         findings: list[dict] = []

@@ -202,6 +202,7 @@ def build_report(
     block_sizes: Sequence[int] = DEFAULT_BLOCK_SIZES,
     byte_volumes: Optional[Mapping[str, Any]] = None,
     overlap_by_class: Optional[Mapping[str, Any]] = None,
+    comms: Optional[Mapping[str, Any]] = None,
     orig_bytes_per_elem: float = 4.0,
 ) -> dict[str, Any]:
     """The quantization-readiness report: one entry per collective class,
@@ -212,9 +213,14 @@ def build_report(
     decoded-cum, ...}}``.  ``byte_volumes`` — planner per-class logical wire
     bytes (``autotune.cost_model.collective_byte_volumes`` shape, or already
     kind-keyed).  ``overlap_by_class`` — the ``trace_summary.json`` section;
-    supplies measured exposed seconds per class.  Savings use the LARGEST
-    block size (most aggressive) — the per-block table shows what backing
-    off buys in error."""
+    supplies measured exposed seconds per class.  ``comms`` — the run/trace
+    summary's ``comms`` section (``telemetry.comms.comms_section``): when a
+    class carries a MEASURED achieved bus rate + bus bytes per step, saved
+    seconds are priced at that wire rate (saved bus bytes / achieved rate)
+    instead of the static exposed-seconds fraction — each class names its
+    ``savings_source``.  Savings use the LARGEST block size (most
+    aggressive) — the per-block table shows what backing off buys in
+    error."""
     block_sizes = tuple(sorted({int(b) for b in block_sizes}))
     if not block_sizes:
         raise ValueError("need at least one block size")
@@ -227,6 +233,8 @@ def build_report(
             by_phase.setdefault(phase, {})[group or phase] = rec
     volumes = _flatten_volumes(byte_volumes)
     overlap = dict(overlap_by_class or {})
+    comms_classes = dict((comms or {}).get("classes") or {}) \
+        if isinstance(comms, Mapping) else {}
 
     classes: dict[str, dict[str, Any]] = {}
     best_b = block_sizes[-1]
@@ -246,9 +254,29 @@ def build_report(
             exposed = float(oc["wire_seconds"]) \
                 - float(oc.get("hidden_seconds", 0.0))
         entry["exposed_seconds"] = exposed
-        entry["predicted_seconds_saved"] = (
-            round(max(float(exposed), 0.0) * saved_frac, 9)
-            if exposed is not None else None)
+        # saved seconds: prefer the MEASURED wire rate (telemetry.comms —
+        # saved bus bytes repriced at the class's achieved bandwidth);
+        # fall back to the static assumption that exposed seconds shrink
+        # proportionally with bytes.  The source is named either way.
+        cc = comms_classes.get(kind)
+        rate = None
+        bus_bytes = None
+        if isinstance(cc, Mapping):
+            try:
+                rate = float(cc.get("achieved_gbps") or 0.0) * 1e9
+                bus_bytes = float(cc.get("bus_bytes_per_step") or 0.0)
+            except (TypeError, ValueError):
+                rate = bus_bytes = None
+        if rate and bus_bytes:
+            entry["predicted_seconds_saved"] = round(
+                bus_bytes * saved_frac / rate, 9)
+            entry["savings_source"] = "measured_wire_rate"
+        elif exposed is not None:
+            entry["predicted_seconds_saved"] = round(
+                max(float(exposed), 0.0) * saved_frac, 9)
+            entry["savings_source"] = "static_exposed_fraction"
+        else:
+            entry["predicted_seconds_saved"] = None
         if volumes.get(kind) is not None:
             entry["bytes_saved_per_step"] = round(
                 float(volumes[kind]) * saved_frac, 3)
@@ -313,8 +341,15 @@ def load_run_dir(run_dir: str | os.PathLike) -> dict[str, Any]:
             f"and re-run"
         )
     overlap = None
+    comms = None
     ts_path = os.path.join(d, "trace_summary.json")
     if os.path.exists(ts_path):
         with open(ts_path) as f:
-            overlap = (json.load(f) or {}).get("overlap_by_class")
-    return {"tensorstats": tensorstats, "overlap_by_class": overlap}
+            doc = json.load(f) or {}
+        overlap = doc.get("overlap_by_class")
+        comms = doc.get("comms")
+    if comms is None and os.path.exists(rs):
+        with open(rs) as f:
+            comms = (json.load(f) or {}).get("comms")
+    return {"tensorstats": tensorstats, "overlap_by_class": overlap,
+            "comms": comms}

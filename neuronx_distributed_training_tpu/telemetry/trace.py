@@ -189,6 +189,11 @@ class TraceCapture:
         # trainer sets them once the schedule resolves; pp > 1 turns the
         # analyzed summary's "pipeline" section on
         self.pipeline = dict(pipeline) if pipeline else None
+        # interconnect facts (telemetry.comms.comms_section inputs) — the
+        # trainer sets them once the plan resolves; joining the analyzed
+        # per-class wire seconds with the cost model's byte volumes turns
+        # the summary's "comms" section (achieved_gbps / efficiency) on
+        self.comms: Optional[dict[str, Any]] = None
         self.active = False
         self.done = False
         self.summary: Optional[dict[str, Any]] = None
@@ -234,6 +239,20 @@ class TraceCapture:
                 "start_step": self.cfg.start_step,
                 "num_steps": self.cfg.num_steps,
             }
+            if self.comms:
+                try:
+                    from neuronx_distributed_training_tpu.telemetry.comms \
+                        import comms_section
+
+                    section = comms_section(
+                        self.comms,
+                        self.summary.get("overlap_by_class") or {},
+                        window_steps=self.cfg.num_steps,
+                    )
+                    if section:
+                        self.summary["comms"] = section
+                except Exception as e:  # noqa: BLE001 — telemetry only
+                    logger.warning("comms bandwidth join failed: %s", e)
             # atomic (temp + rename): a kill mid-write must not leave torn
             # JSON for the report tools / perf-contract extraction to choke on
             from neuronx_distributed_training_tpu.utils.io import (

@@ -285,6 +285,18 @@ class ExpManager:
         if self._trace is not None:
             self._trace.pipeline = dict(facts) if facts else None
 
+    def set_comms_facts(self, facts: Optional[dict[str, Any]]) -> None:
+        """Arm the trace capture's interconnect join with the cost model's
+        per-axis byte volumes and the topology peak
+        (``telemetry.comms.comms_section`` inputs).  The trainer calls this
+        once the plan resolves; the next closed trace window then joins the
+        MEASURED per-class wire seconds with the priced byte volumes into
+        a ``"comms"`` section — per-class achieved_gbps and efficiency —
+        in ``trace_summary.json`` / ``run_summary.json`` and through the
+        metric sinks as ``comms/*`` scalars."""
+        if self._trace is not None:
+            self._trace.comms = dict(facts) if facts else None
+
     def maybe_trace(self, step: int) -> None:
         """Advance the ``telemetry.trace`` capture window (no-op when the
         knob is off).  When the window closes, the analyzed summary is in
@@ -326,6 +338,26 @@ class ExpManager:
                           "straggler_stage", "lane_resolution", "num_lanes")
                 if pipe.get(k) is not None
             }
+        comms = summary.get("comms")
+        if isinstance(comms, dict):
+            # the achieved-bandwidth join is a run fact too: per-class
+            # achieved_gbps/efficiency at the top level for the perf
+            # contract's PC204 extraction, and comms/* scalars through
+            # every sink (and the fleet beacon's metric pick)
+            section["comms"] = comms
+            try:
+                from neuronx_distributed_training_tpu.telemetry.comms import (
+                    comms_metrics,
+                )
+
+                scalars = comms_metrics(comms)
+                if scalars:
+                    window = summary.get("window") or {}
+                    step = int(window.get("start_step", 0) or 0) + int(
+                        window.get("num_steps", 0) or 0)
+                    self.log_metrics(step, scalars, force=True)
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                logger.warning("comms metric emission failed: %s", e)
         self.write_run_summary(section)
 
     # -- per-step hooks -----------------------------------------------------

@@ -969,6 +969,37 @@ class Trainer:
             int(mesh_cfg.virtual_pipeline_model_parallel_size or 1),
             run_facts["bubble_fraction_predicted"],
             ticks_per_step=ticks_per_step))
+        # arm the interconnect join (telemetry.comms): the cost model's
+        # per-axis byte volumes + the topology's ICI prior let a closed
+        # trace window turn per-class wire seconds into achieved_gbps /
+        # efficiency — the "comms" section of trace_summary/run_summary
+        try:
+            from neuronx_distributed_training_tpu.autotune.cost_model import (
+                ModelFacts,
+                collective_byte_volumes,
+            )
+            from neuronx_distributed_training_tpu.autotune.topology import (
+                resolve_topology,
+            )
+            from neuronx_distributed_training_tpu.telemetry.comms import (
+                MESH_TO_AXIS,
+            )
+
+            plan_facts = ModelFacts.from_config(cfg)
+            declared = plan_facts.declared_plan_for(n_chips)
+            if declared is not None:
+                topo = resolve_topology(device=devices[0])
+                exp.set_comms_facts({
+                    "byte_volumes": collective_byte_volumes(
+                        plan_facts, declared),
+                    "axis_sizes": {MESH_TO_AXIS[k]: int(v)
+                                   for k, v in dict(mesh.shape).items()
+                                   if k in MESH_TO_AXIS},
+                    "peak_bandwidth_bytes": topo.ici_bandwidth_bytes,
+                    "topology": topo.name,
+                })
+        except Exception as e:  # noqa: BLE001 — observability, not load-bearing
+            logger.warning("comms telemetry arming unavailable: %s", e)
         try:
             fwd_flops = _perf.flops_for_model(model_cfg, seq_len)
             run_facts["fwd_flops_per_token"] = fwd_flops

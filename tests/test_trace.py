@@ -651,6 +651,36 @@ class TestLiveTraceSmoke:
             summary["exposed_collective_seconds"])
         assert tr["summary_path"].endswith("trace_summary.json")
 
+    def test_comms_section_joins_live_wire_times(self, traced_run):
+        # the interconnect observatory's in-loop layer: the cost model's
+        # per-class byte volumes joined with the traced wire seconds into
+        # achieved bus bandwidth + efficiency vs the topology peak
+        from neuronx_distributed_training_tpu.telemetry.comms import (
+            comms_metrics,
+        )
+
+        _, _, summary, run_summary, _ = traced_run
+        section = summary.get("comms")
+        assert section, "trace summary carries no comms section"
+        assert section["window_steps"] == 2
+        assert section["topology"] == "cpu"
+        assert section["peak_bandwidth_gbps"] > 0
+        for kind, e in section["classes"].items():
+            assert kind in summary["overlap_by_class"]
+            assert e["achieved_gbps"] > 0
+            assert e["bus_bytes_per_step"] > 0
+            assert e["wire_seconds_per_step"] > 0
+            assert e["efficiency"] > 0
+            assert e["count"] > 0
+        # run_summary mirrors the section at the TOP level (where the perf
+        # contract's run-dir extraction and tools/comms_report.py read it),
+        # and the flattened scalars rode the metric stream to every sink
+        assert run_summary["comms"] == section
+        scalars = comms_metrics(section)
+        kind = sorted(section["classes"])[0]
+        assert f"comms/{kind}/achieved_gbps" in scalars
+        assert f"comms/{kind}/efficiency" in scalars
+
     def test_calibrates_the_planner_end_to_end(self, traced_run):
         # the full loop: captured trace -> measured overlap -> plan pricing
         from neuronx_distributed_training_tpu.autotune import plan_config

@@ -287,6 +287,30 @@ def perf_contract_section(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def comms_section(summary: dict) -> str:
+    """In-loop achieved interconnect bandwidth (telemetry.comms — the
+    trainer's join of traced per-class wire seconds with the cost model's
+    byte volumes; tools/comms_report.py renders the standalone sweep)."""
+    comms = summary.get("comms")
+    if not isinstance(comms, dict) or not comms.get("classes"):
+        return ""
+    peak = comms.get("peak_bandwidth_gbps")
+    lines = ["", f"interconnect (measured achieved bandwidth vs "
+                 f"{_fmt(peak) if peak is not None else '?'} GB/s topology "
+                 f"peak — docs/observability.md 'Interconnect observatory')"]
+    for kind in sorted(comms["classes"]):
+        e = comms["classes"][kind]
+        if not isinstance(e, dict):
+            continue
+        eff = e.get("efficiency")
+        lines.append(
+            f"  {kind:<20} achieved={_fmt(e.get('achieved_gbps'))} GB/s"
+            + (f"  efficiency={100 * eff:.1f}%" if eff is not None else "")
+            + (f"  wire_s/step={_fmt(e.get('wire_seconds_per_step'), 6)}"
+               if e.get("wire_seconds_per_step") is not None else ""))
+    return "\n".join(lines)
+
+
 def alerts_section(summary: dict) -> str:
     """Alert-engine trail (telemetry.alerts -> run_summary.json "alerts"):
     one line per firing, with the action the loop took."""
@@ -543,6 +567,7 @@ def render(metrics_path: str | None, summary_path: str | None,
         parts.append(alerts_section(summary))
         parts.append(control_section(summary))
         parts.append(census_section(summary))
+        parts.append(comms_section(summary))
         parts.append(provenance_section(summary))
         parts.append(perf_contract_section(summary))
     parts.append(memory_section(summary, run_dir))

@@ -177,13 +177,16 @@ def main() -> None:
                          "'# autotune-waiver:' comment)")
     ap.add_argument("--calibrate-from", metavar="SUMMARY",
                     help="a trace_summary.json (telemetry.trace), a "
-                         "memory_summary.json (telemetry.memory), or a run "
-                         "dir holding either/both: price comms with the "
-                         "MEASURED per-collective-class overlap and/or the "
-                         "HBM model with MEASURED per-subsystem ratios "
+                         "memory_summary.json (telemetry.memory), a "
+                         "comms_summary.json (tools/comms_bench.py), or a "
+                         "run dir holding any of them: price comms with the "
+                         "MEASURED per-collective-class overlap, the HBM "
+                         "model with MEASURED per-subsystem ratios, and/or "
+                         "the interconnect with MEASURED per-axis bandwidth "
                          "instead of the built-in priors "
                          "(docs/observability.md 'Device-time profiling' / "
-                         "'Memory observability')")
+                         "'Memory observability' / 'Interconnect "
+                         "observatory')")
     ap.add_argument("--apply", metavar="OUT_YAML",
                     help="write a copy of the (single) config with the "
                          "winning knobs imposed")

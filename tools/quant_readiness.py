@@ -87,6 +87,11 @@ def render(report: dict) -> str:
             head += f"  exposed_s={_fmt(float(e['exposed_seconds']), 6)}"
         if e.get("predicted_seconds_saved") is not None:
             head += f"  saved_s={_fmt(e['predicted_seconds_saved'], 6)}"
+            if e.get("savings_source"):
+                # measured_wire_rate (telemetry.comms achieved bandwidth)
+                # vs static_exposed_fraction — never leave the provenance
+                # of a predicted saving unstated
+                head += f" ({e['savings_source']})"
         lines.append(head)
         if "pooled" in e:
             for bs, p in e["pooled"].items():
@@ -147,6 +152,7 @@ def main(argv: list[str] | None = None) -> int:
             inputs["tensorstats"], block_sizes=block_sizes,
             byte_volumes=volumes,
             overlap_by_class=inputs["overlap_by_class"],
+            comms=inputs.get("comms"),
             orig_bytes_per_elem=args.orig_bytes,
         )
     except (OSError, ValueError, KeyError) as e:
